@@ -1,0 +1,13 @@
+"""deepfm_tpu — a TPU-native distributed CTR-training framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+``aws-samples/deepfm-tensorflow-distributed-training-on-amazon-sagemaker``:
+DeepFM-family models, sharded embedding tables over a device mesh (the
+parameter-server capability), SPMD data parallelism (the Horovod capability),
+a streaming TFRecord data plane (File/Pipe-mode capability), checkpoint/
+export/infer tasks, and a multi-host launcher.
+"""
+
+__version__ = "0.1.0"
+
+from .core.config import Config, DataConfig, MeshConfig, ModelConfig, OptimizerConfig, RunConfig  # noqa: F401

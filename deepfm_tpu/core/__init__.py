@@ -1,0 +1,1 @@
+from .config import Config, DataConfig, MeshConfig, ModelConfig, OptimizerConfig, RunConfig  # noqa: F401

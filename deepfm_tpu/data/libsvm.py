@@ -1,0 +1,110 @@
+"""libsvm <-> TFRecord conversion tooling.
+
+Behavior parity with the reference's offline converter
+(tools/libsvm_to_tfrecord.py:22-59): each line ``label id:val id:val ...``
+becomes one Example{label, ids, values} record.  Unlike the reference, paths
+are arguments rather than hardcoded (tools:64-76), a reverse converter and a
+synthetic-data generator are provided for tests/benchmarks, and no TF session
+is needed.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator
+
+import numpy as np
+
+from .example_proto import parse_example, serialize_ctr_example
+from .tfrecord import TFRecordWriter, read_records
+
+
+def parse_libsvm_line(line: str) -> tuple[float, list[int], list[float]]:
+    data = line.split()
+    label = float(data[0])
+    ids, values = [], []
+    for fea in data[1:]:
+        i, v = fea.split(":")
+        ids.append(int(i))
+        values.append(float(v))
+    return label, ids, values
+
+
+def libsvm_to_tfrecord(
+    input_filename: str | os.PathLike,
+    output_filename: str | os.PathLike,
+    *,
+    pad_to_field_size: int | None = None,
+) -> int:
+    """Convert a libsvm file to TFRecord.  Returns the record count.
+
+    ``pad_to_field_size``: the reference assumes every line already has
+    exactly ``field_size`` pairs (Criteo preprocessed data); when set, shorter
+    lines are padded with (id=0, value=0.0) so downstream fixed-shape parsing
+    holds.  ``None`` reproduces the reference's write-as-is behavior.
+    """
+    count = 0
+    with TFRecordWriter(output_filename) as w:
+        with open(input_filename, "r") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                label, ids, values = parse_libsvm_line(line)
+                if pad_to_field_size is not None:
+                    pad = pad_to_field_size - len(ids)
+                    if pad < 0:
+                        raise ValueError(
+                            f"line has {len(ids)} features > field_size "
+                            f"{pad_to_field_size}"
+                        )
+                    ids += [0] * pad
+                    values += [0.0] * pad
+                w.write(serialize_ctr_example(label, ids, values))
+                count += 1
+    return count
+
+
+def tfrecord_to_libsvm(input_filename: str | os.PathLike) -> Iterator[str]:
+    """Inverse transform (not in the reference; useful for round-trip tests)."""
+    for rec in read_records(input_filename):
+        parsed = parse_example(rec)
+        label = float(np.asarray(parsed["label"])[0])
+        ids = np.asarray(parsed["ids"])
+        vals = np.asarray(parsed["values"])
+        pairs = " ".join(f"{i}:{v:g}" for i, v in zip(ids, vals))
+        yield f"{label:g} {pairs}"
+
+
+def generate_synthetic_ctr(
+    path: str | os.PathLike,
+    *,
+    num_records: int,
+    feature_size: int = 117_581,
+    field_size: int = 39,
+    seed: int = 0,
+) -> None:
+    """Write synthetic Criteo-shaped records (13 numeric + categorical fields
+    drawn with a skewed (Zipf-ish) id distribution, matching the hot-row
+    imbalance that makes sharded-embedding load balancing hard)."""
+    rng = np.random.default_rng(seed)
+    num_numeric = min(13, field_size)
+    if feature_size <= num_numeric + 1:
+        raise ValueError(
+            f"feature_size={feature_size} must exceed num_numeric+1="
+            f"{num_numeric + 1} to leave room for categorical ids"
+        )
+    with TFRecordWriter(path) as w:
+        for _ in range(num_records):
+            label = float(rng.random() < 0.25)
+            numeric_ids = np.arange(1, num_numeric + 1, dtype=np.int64)
+            cat = rng.zipf(1.3, size=field_size - num_numeric).astype(np.int64)
+            cat = num_numeric + 1 + (cat % (feature_size - num_numeric - 1))
+            ids = np.concatenate([numeric_ids, cat])
+            values = np.concatenate(
+                [
+                    rng.random(num_numeric).astype(np.float32),
+                    np.ones(field_size - num_numeric, dtype=np.float32),
+                ]
+            )
+            w.write(serialize_ctr_example(label, ids.tolist(), values.tolist()))

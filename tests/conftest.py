@@ -1,0 +1,28 @@
+"""Test harness: force an 8-device virtual CPU mesh BEFORE jax initializes.
+
+This is the fake-backend story the reference never had (SURVEY.md §4):
+pjit/GSPMD collectives run deterministically on N virtual CPU devices, so
+multi-chip sharding is exercised in CI without a pod.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pathlib
+
+import pytest
+
+REFERENCE_VAL_TFRECORDS = pathlib.Path("/root/reference/data/val.tfrecords")
+
+
+@pytest.fixture(scope="session")
+def reference_val_tfrecords():
+    if not REFERENCE_VAL_TFRECORDS.exists():
+        pytest.skip("reference val.tfrecords not available")
+    return REFERENCE_VAL_TFRECORDS
