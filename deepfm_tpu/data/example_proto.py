@@ -59,7 +59,7 @@ def decode_varint(buf: bytes, pos: int) -> tuple[int, int]:
             raise ValueError("varint too long")
 
 
-def _zigzag_i64(n: int) -> int:
+def _uvarint_to_i64(n: int) -> int:
     """Interpret an unsigned varint as two's-complement int64 (proto int64)."""
     return n - (1 << 64) if n >= (1 << 63) else n
 
@@ -173,9 +173,9 @@ def _parse_int64_list(buf: bytes, start: int, end: int) -> np.ndarray:
             pos = s
             while pos < e:
                 v, pos = decode_varint(buf, pos)
-                out.append(_zigzag_i64(v))
+                out.append(_uvarint_to_i64(v))
         elif wire == 0:
-            out.append(_zigzag_i64(val))
+            out.append(_uvarint_to_i64(val))
     return np.asarray(out, dtype=np.int64)
 
 

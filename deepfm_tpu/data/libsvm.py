@@ -44,8 +44,9 @@ def libsvm_to_tfrecord(
     holds.  ``None`` reproduces the reference's write-as-is behavior.
     """
     count = 0
-    with TFRecordWriter(output_filename) as w:
-        with open(input_filename, "r") as f:
+    # open input first so a bad input path can't leave a truncated output
+    with open(input_filename, "r") as f:
+        with TFRecordWriter(output_filename) as w:
             for line in f:
                 line = line.strip()
                 if not line:

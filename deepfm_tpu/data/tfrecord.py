@@ -110,6 +110,9 @@ def read_records(
 
     Works on any readable binary stream (regular file, FIFO — the
     streaming/pipe-mode capability of the reference's PipeModeDataset).
+    When given a path, the file is closed on exhaustion or generator
+    close/GC; partially-consumed generators should be ``.close()``d (or
+    wrapped in ``contextlib.closing``) to release the fd promptly.
     """
     own = False
     if isinstance(path_or_file, (str, os.PathLike)):

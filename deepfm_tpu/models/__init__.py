@@ -1,0 +1,2 @@
+from .base import ModelDef, get_model, register_model, registered_models  # noqa: F401
+from .deepfm import apply_deepfm, deepfm_l2_penalty, init_deepfm  # noqa: F401

@@ -1,0 +1,5 @@
+from .auc import AUCState, auc_init, auc_merge, auc_update, auc_value, exact_auc  # noqa: F401
+from .batch_norm import BNParams, BNState, batch_norm, bn_init  # noqa: F401
+from .embedding import dense_lookup, scaled_embedding  # noqa: F401
+from .fm import fm_first_order, fm_second_order, fm_second_order_pairwise  # noqa: F401
+from .initializers import glorot_normal, glorot_uniform  # noqa: F401

@@ -1,0 +1,57 @@
+"""Functional batch normalization with moving statistics.
+
+Capability parity with the reference's dual-graph ``contrib.layers.batch_norm``
+helper (ps:316-338): train mode normalizes by batch statistics and updates
+the moving averages in place (``updates_collections=None`` semantics); eval
+mode normalizes by the moving averages.  Here the moving stats are explicit
+functional state threaded through the step (no graph collections, no
+``tf.cond`` dual graphs — one traced function per mode).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class BNState(NamedTuple):
+    moving_mean: jnp.ndarray  # [C]
+    moving_var: jnp.ndarray   # [C]
+
+
+class BNParams(NamedTuple):
+    scale: jnp.ndarray  # gamma [C]
+    bias: jnp.ndarray   # beta  [C]
+
+
+def bn_init(num_features: int, dtype=jnp.float32) -> tuple[BNParams, BNState]:
+    return (
+        BNParams(jnp.ones(num_features, dtype), jnp.zeros(num_features, dtype)),
+        BNState(jnp.zeros(num_features, dtype), jnp.ones(num_features, dtype)),
+    )
+
+
+def batch_norm(
+    x: jnp.ndarray,
+    params: BNParams,
+    state: BNState,
+    *,
+    train: bool,
+    decay: float = 0.9,
+    eps: float = 0.001,  # contrib.layers.batch_norm default epsilon
+) -> tuple[jnp.ndarray, BNState]:
+    """Returns (normalized x, new state).  x: [B, C]."""
+    if train:
+        mean = jnp.mean(x, axis=0)
+        var = jnp.var(x, axis=0)
+        new_state = BNState(
+            decay * state.moving_mean + (1.0 - decay) * mean,
+            decay * state.moving_var + (1.0 - decay) * var,
+        )
+    else:
+        mean, var = state.moving_mean, state.moving_var
+        new_state = state
+    inv = jnp.reciprocal(jnp.sqrt(var + eps))
+    y = (x - mean) * inv * params.scale + params.bias
+    return y, new_state

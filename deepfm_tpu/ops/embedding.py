@@ -1,0 +1,31 @@
+"""Embedding lookup ops.
+
+Dense (replicated-table) path for single-chip / small-vocab runs — the
+``tf.nn.embedding_lookup`` capability (reference ps:206, ps:212).  The
+row-sharded multi-chip lookup lives in ``deepfm_tpu/parallel/embedding.py``;
+both expose the same ``lookup(table, ids) -> rows`` signature so models are
+agnostic to the sharding strategy.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def dense_lookup(table: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
+    """Gather rows: table [V] or [V, K], ids [B, F] -> [B, F] or [B, F, K].
+
+    ``mode="clip"`` matches XLA:TPU's in-bounds guarantee while keeping the
+    op fully vectorizable (no dynamic bounds checks in the hot path).
+    """
+    return jnp.take(table, ids, axis=0, mode="clip")
+
+
+def scaled_embedding(
+    table: jnp.ndarray, ids: jnp.ndarray, vals: jnp.ndarray
+) -> jnp.ndarray:
+    """``e_ij = V[id_ij] * x_ij`` — the FM input tensor (ps:212-214).
+
+    table [V, K], ids [B, F], vals [B, F] -> [B, F, K].
+    """
+    return dense_lookup(table, ids) * vals[..., None]

@@ -1,0 +1,40 @@
+"""Factorization-machine interaction ops.
+
+The FM second-order term uses the O(F·K) identity
+``y = 0.5 · Σ_k ((Σ_f e)² − Σ_f e²)`` instead of O(F²·K) pairwise products —
+same math as the reference (ps:211-217), expressed as fused elementwise +
+reductions that XLA maps onto the VPU in one pass over the [B, F, K] tensor.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def fm_first_order(feat_weights: jnp.ndarray, feat_vals: jnp.ndarray) -> jnp.ndarray:
+    """``y_w = Σ_f w_f · x_f``  (reference ps:207-209).
+
+    feat_weights: [B, F] gathered FM_W rows; feat_vals: [B, F].  Returns [B].
+    """
+    return jnp.sum(feat_weights * feat_vals, axis=1)
+
+
+def fm_second_order(embeddings: jnp.ndarray) -> jnp.ndarray:
+    """``y_v = 0.5 Σ_k ((Σ_f e)² − Σ_f e²)``  (reference ps:211-217).
+
+    embeddings: [B, F, K] — already scaled by feature values (v_ij · x_i).
+    Returns [B].
+    """
+    sum_f = jnp.sum(embeddings, axis=1)            # [B, K]
+    sum_square = jnp.square(sum_f)                 # (Σ_f e)²
+    square_sum = jnp.sum(jnp.square(embeddings), axis=1)  # Σ_f e²
+    return 0.5 * jnp.sum(sum_square - square_sum, axis=1)
+
+
+def fm_second_order_pairwise(embeddings: jnp.ndarray) -> jnp.ndarray:
+    """O(F²) explicit pairwise form — test oracle for the identity above."""
+    # Σ_{i<j} <e_i, e_j>
+    gram = jnp.einsum("bik,bjk->bij", embeddings, embeddings)
+    f = embeddings.shape[1]
+    mask = jnp.triu(jnp.ones((f, f), dtype=embeddings.dtype), k=1)
+    return jnp.sum(gram * mask, axis=(1, 2))

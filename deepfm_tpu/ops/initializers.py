@@ -1,0 +1,43 @@
+"""Initializers matching TF1 semantics for convergence parity.
+
+The reference initializes FM_W/FM_V with ``tf.glorot_normal_initializer()``
+(ps:190-197) — variance scaling, fan_avg, *truncated* normal with the
+0.87962566 correction — and the MLP with ``xavier_initializer()`` (glorot
+uniform, the tf.contrib.layers.fully_connected default) and zero biases.
+JAX's stock glorot initializers reject rank-1 shapes (FM_W is [V]), so we
+implement TF's fan computation: for rank-1, fan_in = fan_out = shape[0].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# stddev correction for a normal truncated to ±2σ (TF's _compute_fans path)
+_TRUNC_CORRECTION = 0.87962566103423978
+
+
+def _fans(shape: tuple[int, ...]) -> tuple[float, float]:
+    if len(shape) < 1:
+        return 1.0, 1.0
+    if len(shape) == 1:
+        return float(shape[0]), float(shape[0])
+    receptive = 1
+    for d in shape[:-2]:
+        receptive *= d
+    return float(shape[-2] * receptive), float(shape[-1] * receptive)
+
+
+def glorot_normal(key: jax.Array, shape: tuple[int, ...], dtype=jnp.float32) -> jax.Array:
+    """TF ``glorot_normal_initializer``: truncated normal, fan_avg scaling."""
+    fan_in, fan_out = _fans(shape)
+    scale = 2.0 / (fan_in + fan_out)
+    stddev = (scale**0.5) / _TRUNC_CORRECTION
+    return stddev * jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype)
+
+
+def glorot_uniform(key: jax.Array, shape: tuple[int, ...], dtype=jnp.float32) -> jax.Array:
+    """TF ``xavier_initializer`` (the fully_connected default)."""
+    fan_in, fan_out = _fans(shape)
+    limit = (6.0 / (fan_in + fan_out)) ** 0.5
+    return jax.random.uniform(key, shape, dtype, -limit, limit)

@@ -1,0 +1,87 @@
+"""Optimizer construction — parity with the reference's selection block
+(ps:292-305): Adam / Adagrad / Momentum / Ftrl with the exact TF1
+hyperparameters, built on optax transforms (FTRL implemented here; optax has
+no FTRL).  The Horovod path's lr×world_size scaling (hvd:171) is an explicit
+config knob applied by the caller via ``data_parallel_size``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..core.config import OptimizerConfig
+
+
+class FtrlState(NamedTuple):
+    z: optax.Updates
+    n: optax.Updates
+
+
+def ftrl(
+    learning_rate: float,
+    *,
+    learning_rate_power: float = -0.5,
+    initial_accumulator_value: float = 0.1,
+    l1: float = 0.0,
+    l2: float = 0.0,
+) -> optax.GradientTransformation:
+    """FTRL-Proximal (McMahan et al.), matching ``tf.train.FtrlOptimizer``
+    defaults (ps:304-305).  Note FTRL rewrites weights from its own state, so
+    updates returned are ``w_new - w_old``."""
+
+    def init_fn(params):
+        return FtrlState(
+            z=jax.tree_util.tree_map(jnp.zeros_like, params),
+            n=jax.tree_util.tree_map(
+                lambda p: jnp.full_like(p, initial_accumulator_value), params
+            ),
+        )
+
+    def update_fn(grads, state, params):
+        if params is None:
+            raise ValueError("ftrl requires params")
+        p = -learning_rate_power
+        tm = jax.tree_util.tree_map
+        n_new = tm(lambda g, n: n + jnp.square(g), grads, state.n)
+        z_new = tm(
+            lambda g, z, n2, n, w: z + g - (n2**p - n**p) / learning_rate * w,
+            grads, state.z, n_new, state.n, params,
+        )
+        w_new = tm(
+            lambda z2, n2, w: jnp.where(
+                jnp.abs(z2) <= l1,
+                jnp.zeros_like(w),
+                -(z2 - jnp.sign(z2) * l1) / ((n2**p) / learning_rate + 2.0 * l2),
+            ),
+            z_new, n_new, params,
+        )
+        updates = tm(lambda wn, w: wn - w, w_new, params)
+        return updates, FtrlState(z=z_new, n=n_new)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def build_optimizer(
+    cfg: OptimizerConfig, *, data_parallel_size: int = 1
+) -> optax.GradientTransformation:
+    lr = cfg.learning_rate
+    if cfg.scale_lr_by_data_parallel:
+        lr = lr * data_parallel_size  # hvd:171 semantics, now explicit
+    name = cfg.name.lower()
+    if name == "adam":
+        return optax.adam(lr, b1=cfg.adam_b1, b2=cfg.adam_b2, eps=cfg.adam_eps)
+    if name == "adagrad":
+        # TF Adagrad has no epsilon term; the initial accumulator provides
+        # numeric floor (ps:296-298)
+        return optax.adagrad(
+            lr, initial_accumulator_value=cfg.adagrad_init_accum, eps=0.0
+        )
+    if name == "momentum":
+        return optax.sgd(lr, momentum=cfg.momentum, nesterov=False)
+    if name == "ftrl":
+        return ftrl(lr)
+    raise ValueError(f"unknown optimizer {cfg.name!r} (Adam|Adagrad|Momentum|Ftrl)")

@@ -1,0 +1,163 @@
+"""Jitted train/eval/predict steps — the Estimator-loop capability (ps:492-521)
+re-expressed as pure functions over an explicit ``TrainState``.
+
+One traced, compiled function per mode (TRAIN/EVAL/PREDICT) replaces the
+reference's mode-switched ``model_fn``: no graph collections, no sessions —
+each step is a single XLA executable dispatched per batch, donation-friendly
+so parameter buffers update in place in HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..core.config import Config
+from ..models.base import get_model
+from ..ops.auc import AUCState, auc_init, auc_update
+from .optimizer import build_optimizer
+
+
+class TrainState(NamedTuple):
+    step: jnp.ndarray          # i32 scalar — the global_step (ps:307)
+    params: Any
+    model_state: Any           # non-trainable (BN moving stats)
+    opt_state: Any
+    rng: jax.Array             # dropout key, folded per step
+
+
+def sigmoid_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Elementwise ``tf.nn.sigmoid_cross_entropy_with_logits`` (ps:276)."""
+    return jnp.maximum(logits, 0.0) - logits * labels + jnp.log1p(
+        jnp.exp(-jnp.abs(logits))
+    )
+
+
+def make_loss_fn(cfg: Config, model, lookup_fn=None) -> Callable:
+    """loss = mean CE + the model family's L2 penalty (reference: ps:275-279
+    applies l2_reg·(½‖FM_W‖²+½‖FM_V‖²); each ModelDef declares its own)."""
+    apply_fn, l2_penalty = model.apply, model.l2_penalty
+
+    def loss_fn(params, model_state, batch, rng, train: bool):
+        kwargs = {} if lookup_fn is None else {"lookup_fn": lookup_fn}
+        logits, new_state = apply_fn(
+            params,
+            model_state,
+            batch["feat_ids"],
+            batch["feat_vals"],
+            cfg=cfg.model,
+            train=train,
+            rng=rng,
+            **kwargs,
+        )
+        labels = batch["label"].reshape(-1).astype(jnp.float32)
+        ce = jnp.mean(sigmoid_cross_entropy(logits, labels))
+        loss = ce + l2_penalty(params, cfg.model.l2_reg)
+        return loss, (logits, new_state)
+
+    return loss_fn
+
+
+def create_train_state(cfg: Config, key: jax.Array | None = None) -> TrainState:
+    key = jax.random.PRNGKey(cfg.run.seed) if key is None else key
+    init_key, step_key = jax.random.split(key)
+    model = get_model(cfg.model)
+    params, model_state = model.init(init_key, cfg.model)
+    tx = build_optimizer(cfg.optimizer, data_parallel_size=_dp_size(cfg))
+    opt_state = tx.init(params)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        model_state=model_state,
+        opt_state=opt_state,
+        rng=step_key,
+    )
+
+
+def _dp_size(cfg: Config) -> int:
+    n = cfg.mesh.data_parallel
+    if n > 0:
+        return n
+    return max(1, jax.device_count() // max(1, cfg.mesh.model_parallel))
+
+
+def make_train_step(cfg: Config, lookup_fn=None) -> Callable:
+    """Build ``(state, batch) -> (state, metrics)``.  Jit it yourself or via
+    pjit in ``deepfm_tpu/parallel`` — this function stays sharding-agnostic."""
+    model = get_model(cfg.model)
+    loss_fn = make_loss_fn(cfg, model, lookup_fn)
+    tx = build_optimizer(cfg.optimizer, data_parallel_size=_dp_size(cfg))
+
+    def train_step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
+        step_rng = jax.random.fold_in(state.rng, state.step)
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        (loss, (logits, new_model_state)), grads = grad_fn(
+            state.params, state.model_state, batch, step_rng, True
+        )
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {
+            "loss": loss,
+            "pred_mean": jnp.mean(jax.nn.sigmoid(logits)),
+            "label_mean": jnp.mean(batch["label"].astype(jnp.float32)),
+        }
+        return (
+            TrainState(
+                step=state.step + 1,
+                params=new_params,
+                model_state=new_model_state,
+                opt_state=new_opt_state,
+                rng=state.rng,
+            ),
+            metrics,
+        )
+
+    return train_step
+
+
+def make_eval_step(cfg: Config, lookup_fn=None) -> Callable:
+    """``(state, auc_state, batch) -> (auc_state, metrics)``: loss + streaming
+    AUC accumulation (the reference's eval metric, ps:282)."""
+    model = get_model(cfg.model)
+    loss_fn = make_loss_fn(cfg, model, lookup_fn)
+
+    def eval_step(
+        state: TrainState, auc_state: AUCState, batch: dict
+    ) -> tuple[AUCState, dict]:
+        loss, (logits, _) = loss_fn(
+            state.params, state.model_state, batch, None, False
+        )
+        preds = jax.nn.sigmoid(logits)
+        labels = batch["label"].reshape(-1)
+        new_auc = auc_update(auc_state, labels, preds)
+        return new_auc, {"loss": loss, "count": jnp.asarray(labels.shape[0])}
+
+    return eval_step
+
+
+def make_predict_step(cfg: Config, lookup_fn=None) -> Callable:
+    """``(state, batch) -> prob [B]`` — the PREDICT/serving path (ps:262-272)."""
+    model = get_model(cfg.model)
+
+    def predict_step(state: TrainState, batch: dict) -> jnp.ndarray:
+        kwargs = {} if lookup_fn is None else {"lookup_fn": lookup_fn}
+        logits, _ = model.apply(
+            state.params,
+            state.model_state,
+            batch["feat_ids"],
+            batch["feat_vals"],
+            cfg=cfg.model,
+            train=False,
+            rng=None,
+            **kwargs,
+        )
+        return jax.nn.sigmoid(logits)
+
+    return predict_step
+
+
+def new_auc_state(num_thresholds: int = 200) -> AUCState:
+    return auc_init(num_thresholds)

@@ -7,12 +7,34 @@ multi-chip sharding is exercised in CI without a pod.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# Force CPU regardless of the ambient platform (the session env pins
+# JAX_PLATFORMS to a tunneled TPU backend whose init can take minutes or
+# hang; tests must be fast and deterministic).  Set DEEPFM_TEST_TPU=1 to run
+# tests on the real TPU instead.
+if not os.environ.get("DEEPFM_TEST_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    # The environment's sitecustomize registers an experimental TPU-tunnel
+    # PJRT plugin ("axon") at interpreter start and hooks jax's backend
+    # lookup so that even JAX_PLATFORMS=cpu triggers its (blocking) device
+    # attach.  Also, pytest plugins may import jax before this conftest,
+    # baking the ambient JAX_PLATFORMS in.  Override the live config and
+    # deregister the tunnel factory before any backend is initialized.
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except ImportError:  # pure-data tests run without jax installed
+        pass
+    except Exception:
+        pass
 
 import pathlib
 

@@ -1,0 +1,71 @@
+"""Streaming AUC tests: bucketed metric vs exact rank-based oracle."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepfm_tpu.ops import auc_init, auc_merge, auc_update, auc_value, exact_auc
+
+
+def test_exact_auc_known_values():
+    labels = np.array([0, 0, 1, 1])
+    preds = np.array([0.1, 0.4, 0.35, 0.8])
+    assert exact_auc(labels, preds) == 0.75  # classic sklearn example
+    assert exact_auc(np.array([0, 1]), np.array([0.1, 0.9])) == 1.0
+    assert exact_auc(np.array([1, 0]), np.array([0.1, 0.9])) == 0.0
+    # ties: all equal predictions -> 0.5
+    assert exact_auc(np.array([0, 1, 0, 1]), np.full(4, 0.5)) == 0.5
+
+
+def test_streaming_matches_exact_on_random():
+    rng = np.random.default_rng(0)
+    preds = rng.random(5000).astype(np.float32)
+    labels = (rng.random(5000) < preds).astype(np.float32)  # informative preds
+    st = auc_init(200)
+    for i in range(0, 5000, 512):  # stream in batches
+        st = auc_update(st, jnp.asarray(labels[i : i + 512]), jnp.asarray(preds[i : i + 512]))
+    approx = float(auc_value(st))
+    exact = exact_auc(labels, preds)
+    assert abs(approx - exact) < 5e-3, (approx, exact)
+
+
+def test_streaming_batch_order_invariant():
+    rng = np.random.default_rng(1)
+    preds = rng.random(1000).astype(np.float32)
+    labels = (rng.random(1000) < 0.3).astype(np.float32)
+    st1 = auc_init()
+    st1 = auc_update(st1, jnp.asarray(labels), jnp.asarray(preds))
+    st2 = auc_init()
+    perm = rng.permutation(1000)
+    for i in range(0, 1000, 100):
+        idx = perm[i : i + 100]
+        st2 = auc_update(st2, jnp.asarray(labels[idx]), jnp.asarray(preds[idx]))
+    np.testing.assert_allclose(float(auc_value(st1)), float(auc_value(st2)), rtol=1e-5)
+
+
+def test_merge_equals_single_stream():
+    rng = np.random.default_rng(2)
+    preds = rng.random(800).astype(np.float32)
+    labels = (rng.random(800) < 0.4).astype(np.float32)
+    whole = auc_update(auc_init(), jnp.asarray(labels), jnp.asarray(preds))
+    a = auc_update(auc_init(), jnp.asarray(labels[:400]), jnp.asarray(preds[:400]))
+    b = auc_update(auc_init(), jnp.asarray(labels[400:]), jnp.asarray(preds[400:]))
+    np.testing.assert_allclose(
+        np.asarray(whole.counts), np.asarray(auc_merge(a, b).counts), rtol=1e-6
+    )
+
+
+def test_perfect_and_random_classifiers():
+    labels = jnp.array([0.0, 0, 0, 0, 1, 1, 1, 1])
+    st = auc_update(auc_init(), labels, jnp.array([0.1, 0.2, 0.15, 0.05, 0.9, 0.8, 0.95, 0.7]))
+    assert float(auc_value(st)) > 0.99
+    st = auc_update(auc_init(), labels, jnp.array([0.9, 0.8, 0.95, 0.7, 0.1, 0.2, 0.15, 0.05]))
+    assert float(auc_value(st)) < 0.01
+
+
+def test_weighted_update():
+    labels = jnp.array([0.0, 1.0])
+    preds = jnp.array([0.3, 0.7])
+    w = jnp.array([2.0, 3.0])
+    st = auc_update(auc_init(), labels, preds, weights=w)
+    tp, fp, tn, fn = np.asarray(st.counts)
+    assert tp.max() == 3.0 and tn.max() == 2.0

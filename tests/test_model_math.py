@@ -1,0 +1,200 @@
+"""Model-math unit tests: FM identity, initializer statistics, forward-pass
+shape/semantics, loss parity properties (SURVEY §4 test-pyramid base)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepfm_tpu.core.config import Config, ModelConfig
+from deepfm_tpu.models import get_model
+from deepfm_tpu.ops import (
+    batch_norm,
+    bn_init,
+    dense_lookup,
+    fm_first_order,
+    fm_second_order,
+    fm_second_order_pairwise,
+    glorot_normal,
+    glorot_uniform,
+)
+from deepfm_tpu.train import make_loss_fn, sigmoid_cross_entropy
+
+CFG = ModelConfig(
+    feature_size=200,
+    field_size=7,
+    embedding_size=8,
+    deep_layers=(16, 8),
+    dropout_keep=(1.0, 1.0),
+    compute_dtype="float32",
+)
+
+
+def _batch(key, b=32, cfg=CFG):
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "feat_ids": jax.random.randint(k1, (b, cfg.field_size), 0, cfg.feature_size),
+        "feat_vals": jax.random.uniform(k2, (b, cfg.field_size)),
+        "label": (jax.random.uniform(k3, (b,)) < 0.3).astype(jnp.float32),
+    }
+
+
+def test_fm_identity_matches_pairwise():
+    """0.5((Σe)² − Σe²) == Σ_{i<j}<e_i, e_j> — the core FM algebra (ps:211-217)."""
+    key = jax.random.PRNGKey(0)
+    emb = jax.random.normal(key, (16, 7, 8))
+    np.testing.assert_allclose(
+        fm_second_order(emb), fm_second_order_pairwise(emb), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_fm_first_order():
+    w = jnp.array([[1.0, 2.0], [0.5, -1.0]])
+    x = jnp.array([[3.0, 4.0], [2.0, 2.0]])
+    np.testing.assert_allclose(fm_first_order(w, x), [11.0, -1.0])
+
+
+def test_glorot_normal_stats():
+    k = jax.random.PRNGKey(1)
+    v = glorot_normal(k, (1000, 50))
+    expected_std = (2.0 / (1000 + 50)) ** 0.5
+    assert abs(float(v.std()) - expected_std) < 0.1 * expected_std
+    assert abs(float(v.mean())) < 0.01
+    # truncated at 2 sigma of the pre-correction std
+    assert float(jnp.abs(v).max()) <= 2.0 * expected_std / 0.8796 + 1e-6
+    # rank-1 fan handling (FM_W shape)
+    v1 = glorot_normal(k, (10_000,))
+    assert abs(float(v1.std()) - (1.0 / 10_000) ** 0.5) < 2e-3
+
+
+def test_glorot_uniform_bounds():
+    v = glorot_uniform(jax.random.PRNGKey(2), (300, 100))
+    limit = (6.0 / 400) ** 0.5
+    assert float(jnp.abs(v).max()) <= limit
+    assert float(jnp.abs(v).max()) > 0.9 * limit
+
+
+def test_sigmoid_ce_matches_formula():
+    logits = jnp.array([-10.0, -1.0, 0.0, 1.0, 10.0])
+    labels = jnp.array([0.0, 1.0, 1.0, 0.0, 1.0])
+    expected = -(
+        labels * jax.nn.log_sigmoid(logits) + (1 - labels) * jax.nn.log_sigmoid(-logits)
+    )
+    np.testing.assert_allclose(
+        sigmoid_cross_entropy(logits, labels), expected, rtol=1e-6
+    )
+
+
+def test_deepfm_forward_shapes_and_determinism():
+    model = get_model("deepfm")
+    params, state = model.init(jax.random.PRNGKey(0), CFG)
+    assert params["fm_b"].shape == (1,)
+    assert params["fm_w"].shape == (CFG.feature_size,)
+    assert params["fm_v"].shape == (CFG.feature_size, CFG.embedding_size)
+    assert float(params["fm_b"][0]) == 0.0
+    batch = _batch(jax.random.PRNGKey(3))
+    logits, _ = model.apply(
+        params, state, batch["feat_ids"], batch["feat_vals"], cfg=CFG, train=False
+    )
+    assert logits.shape == (32,)
+    logits2, _ = model.apply(
+        params, state, batch["feat_ids"], batch["feat_vals"], cfg=CFG, train=False
+    )
+    np.testing.assert_array_equal(logits, logits2)
+
+
+def test_deepfm_manual_forward_tiny():
+    """Hand-computed forward on a 1-example, no-deep-tower config."""
+    cfg = ModelConfig(
+        feature_size=4, field_size=2, embedding_size=2, deep_layers=(),
+        dropout_keep=(), compute_dtype="float32", l2_reg=0.0,
+    )
+    model = get_model("deepfm")
+    params, state = model.init(jax.random.PRNGKey(0), cfg)
+    params["fm_b"] = jnp.array([0.5])
+    params["fm_w"] = jnp.array([0.1, 0.2, 0.3, 0.4])
+    params["fm_v"] = jnp.array([[1.0, 0.0], [0.0, 1.0], [1.0, 1.0], [2.0, 2.0]])
+    params["mlp"]["out"]["kernel"] = jnp.zeros_like(params["mlp"]["out"]["kernel"])
+    ids = jnp.array([[1, 2]])
+    vals = jnp.array([[2.0, 3.0]])
+    logits, _ = model.apply(params, state, ids, vals, cfg=cfg, train=False)
+    # y_w = 0.2*2 + 0.3*3 = 1.3
+    # e = [[0,2],[3,3]]; sum_f = [3,5]; sum_sq=[9,25]; sq_sum=[9,4+9=13]
+    # y_v = 0.5*((9-9)+(25-13)) = 6.0
+    # y = 0.5 + 1.3 + 6.0 + 0 = 7.8
+    np.testing.assert_allclose(logits, [7.8], rtol=1e-6)
+
+
+def test_dropout_active_only_in_train():
+    cfg = ModelConfig(
+        feature_size=100, field_size=5, embedding_size=4, deep_layers=(32,),
+        dropout_keep=(0.5,), compute_dtype="float32",
+    )
+    model = get_model("deepfm")
+    params, state = model.init(jax.random.PRNGKey(0), cfg)
+    b = _batch(jax.random.PRNGKey(1), b=16, cfg=cfg)
+    rng = jax.random.PRNGKey(42)
+    train1, _ = model.apply(params, state, b["feat_ids"], b["feat_vals"], cfg=cfg, train=True, rng=rng)
+    train2, _ = model.apply(
+        params, state, b["feat_ids"], b["feat_vals"], cfg=cfg, train=True,
+        rng=jax.random.PRNGKey(43),
+    )
+    assert not np.allclose(train1, train2)  # different masks
+    eval1, _ = model.apply(params, state, b["feat_ids"], b["feat_vals"], cfg=cfg, train=False)
+    eval2, _ = model.apply(params, state, b["feat_ids"], b["feat_vals"], cfg=cfg, train=False)
+    np.testing.assert_array_equal(eval1, eval2)
+
+
+def test_batch_norm_train_vs_eval():
+    params, state = bn_init(4)
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 4)) * 3.0 + 5.0
+    y, new_state = batch_norm(x, params, state, train=True, decay=0.5)
+    assert abs(float(y.mean())) < 0.1
+    assert abs(float(y.std()) - 1.0) < 0.1
+    # moving stats moved toward batch stats
+    assert float(new_state.moving_mean.mean()) > 1.0
+    y_eval, same_state = batch_norm(x, params, new_state, train=False)
+    assert same_state is new_state
+
+
+def test_bn_state_threads_through_model():
+    cfg = ModelConfig(
+        feature_size=50, field_size=3, embedding_size=4, deep_layers=(8,),
+        dropout_keep=(1.0,), batch_norm=True, compute_dtype="float32",
+    )
+    model = get_model("deepfm")
+    params, state = model.init(jax.random.PRNGKey(0), cfg)
+    b = _batch(jax.random.PRNGKey(1), b=16, cfg=cfg)
+    _, new_state = model.apply(
+        params, state, b["feat_ids"], b["feat_vals"], cfg=cfg, train=True,
+        rng=jax.random.PRNGKey(2),
+    )
+    assert not np.allclose(
+        new_state["bn"]["layer_0"].moving_mean, state["bn"]["layer_0"].moving_mean
+    )
+
+
+def test_l2_penalty_in_loss():
+    cfg_dict = {"model": {
+        "feature_size": 200, "field_size": 7, "embedding_size": 8,
+        "deep_layers": (16, 8), "dropout_keep": (1.0, 1.0),
+        "compute_dtype": "float32",
+    }}
+    cfg0 = Config.from_dict(cfg_dict).with_overrides(model={"l2_reg": 0.0})
+    cfg1 = Config.from_dict(cfg_dict).with_overrides(model={"l2_reg": 0.01})
+    model = get_model("deepfm")
+    params, state = model.init(jax.random.PRNGKey(0), cfg0.model)
+    batch = _batch(jax.random.PRNGKey(1))
+    l0, _ = make_loss_fn(cfg0, model)(params, state, batch, None, False)
+    l1, _ = make_loss_fn(cfg1, model)(params, state, batch, None, False)
+    expected_penalty = 0.01 * 0.5 * (
+        float(jnp.sum(params["fm_w"] ** 2)) + float(jnp.sum(params["fm_v"] ** 2))
+    )
+    np.testing.assert_allclose(float(l1 - l0), expected_penalty, rtol=1e-5)
+
+
+def test_lookup_clip_mode_out_of_range():
+    table = jnp.arange(10.0)
+    ids = jnp.array([[0, 9, 50, -3]])
+    out = dense_lookup(table, ids)
+    np.testing.assert_array_equal(out, [[0.0, 9.0, 9.0, 0.0]])

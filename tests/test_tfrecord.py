@@ -35,12 +35,24 @@ def test_crc32c_vectors(data, expected):
     assert crc32c(data) == expected
 
 
-def test_crc32c_incremental_equals_whole():
-    data = bytes(range(256)) * 7 + b"tail"
-    assert crc32c(data) == crc32c(data)  # determinism
-    # odd lengths exercise the tail loop
-    for cut in (0, 1, 7, 8, 9, 63, 64, 65):
-        assert crc32c(data[:cut]) == crc32c(bytes(data[:cut]))
+def test_crc32c_tail_loop_lengths():
+    """Odd lengths exercise the per-byte tail after the 8-byte main loop:
+    cross-check slice-by-8 against a simple byte-at-a-time reference."""
+
+    def crc_ref(data: bytes) -> int:
+        poly = 0x82F63B78
+        crc = 0xFFFFFFFF
+        for b in data:
+            crc ^= b
+            for _ in range(8):
+                crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+        return ~crc & 0xFFFFFFFF
+
+    data = bytes(range(256)) * 2 + b"tail"
+    for cut in (0, 1, 7, 8, 9, 63, 64, 65, len(data)):
+        assert crc32c(data[:cut]) == crc_ref(data[:cut]), cut
+        # incremental chaining via the crc seed argument
+        assert crc32c(data[cut:], crc32c(data[:cut])) == crc32c(data), cut
 
 
 def test_roundtrip_records(tmp_path):

@@ -1,0 +1,140 @@
+"""Train-step tests: optimizer parity, convergence on learnable data, eval/
+predict paths — the minimum end-to-end slice (SURVEY §7 stage 2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepfm_tpu.core.config import Config
+from deepfm_tpu.ops import auc_value, exact_auc
+from deepfm_tpu.train import (
+    build_optimizer,
+    create_train_state,
+    make_eval_step,
+    make_predict_step,
+    make_train_step,
+    new_auc_state,
+)
+
+CFG = Config.from_dict(
+    {
+        "model": {
+            "feature_size": 500,
+            "field_size": 10,
+            "embedding_size": 8,
+            "deep_layers": (32, 16),
+            "dropout_keep": (1.0, 1.0),
+            "l2_reg": 0.0001,
+            "compute_dtype": "float32",
+        },
+        "optimizer": {"learning_rate": 0.01},
+    }
+)
+
+
+def _synthetic_learnable(key, n, cfg):
+    """Labels driven by a ground-truth linear score over feature ids."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    ids = jax.random.randint(k1, (n, cfg.model.field_size), 0, cfg.model.feature_size)
+    vals = jnp.ones((n, cfg.model.field_size))
+    true_w = jax.random.normal(k2, (cfg.model.feature_size,))
+    score = jnp.take(true_w, ids).sum(axis=1) / (cfg.model.field_size**0.5)
+    label = (jax.nn.sigmoid(2.0 * score) > jax.random.uniform(k3, (n,))).astype(
+        jnp.float32
+    )
+    return {"feat_ids": ids, "feat_vals": vals, "label": label}
+
+
+def test_train_loss_decreases_and_auc_improves():
+    state = create_train_state(CFG)
+    data = _synthetic_learnable(jax.random.PRNGKey(0), 4096, CFG)
+    train_step = jax.jit(make_train_step(CFG))
+    losses = []
+    for epoch in range(30):
+        for i in range(0, 4096, 512):
+            batch = {k: v[i : i + 512] for k, v in data.items()}
+            state, metrics = train_step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.8, losses[:3] + losses[-3:]
+    assert int(state.step) == 30 * 8
+
+    # eval: streaming AUC must beat chance comfortably on train data
+    eval_step = jax.jit(make_eval_step(CFG))
+    auc_state = new_auc_state()
+    for i in range(0, 4096, 512):
+        batch = {k: v[i : i + 512] for k, v in data.items()}
+        auc_state, em = eval_step(state, auc_state, batch)
+    auc = float(auc_value(auc_state))
+    assert auc > 0.75, auc
+
+    # bucketed streaming AUC agrees with the exact oracle
+    predict = jax.jit(make_predict_step(CFG))
+    preds = np.concatenate(
+        [np.asarray(predict(state, {k: v[i : i + 512] for k, v in data.items()}))
+         for i in range(0, 4096, 512)]
+    )
+    ex = exact_auc(np.asarray(data["label"]), preds)
+    assert abs(auc - ex) < 0.01, (auc, ex)
+
+
+@pytest.mark.parametrize("name", ["Adam", "Adagrad", "Momentum", "ftrl"])
+def test_all_optimizers_step(name):
+    cfg = CFG.with_overrides(optimizer={"name": name, "learning_rate": 0.05})
+    state = create_train_state(cfg)
+    data = _synthetic_learnable(jax.random.PRNGKey(1), 512, cfg)
+    train_step = jax.jit(make_train_step(cfg))
+    s, m0 = train_step(state, data)
+    for _ in range(20):
+        s, m = train_step(s, data)
+    assert float(m["loss"]) < float(m0["loss"]), name
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_adam_matches_tf1_formula_single_param():
+    """One Adam step on a scalar must match the TF1/Kingma update exactly."""
+    tx = build_optimizer(CFG.optimizer.__class__(learning_rate=0.1))
+    p = {"w": jnp.array([1.0])}
+    g = {"w": jnp.array([0.5])}
+    st = tx.init(p)
+    updates, _ = tx.update(g, st, p)
+    m = 0.1 * 0.5
+    v = 0.001 * 0.25
+    mhat = m / (1 - 0.9)
+    vhat = v / (1 - 0.999)
+    expected = -0.1 * mhat / (np.sqrt(vhat) + 1e-8)
+    np.testing.assert_allclose(np.asarray(updates["w"]), [expected], rtol=1e-5)
+
+
+def test_ftrl_sparsity_with_l1():
+    from deepfm_tpu.train import ftrl
+
+    tx = ftrl(0.5, l1=10.0)
+    p = {"w": jnp.array([0.1, -0.2])}
+    st = tx.init(p)
+    g = {"w": jnp.array([0.01, -0.01])}
+    updates, st = tx.update(g, st, p)
+    new_w = p["w"] + updates["w"]
+    np.testing.assert_allclose(np.asarray(new_w), [0.0, 0.0], atol=1e-7)
+
+
+def test_lr_scaling_knob():
+    cfg = CFG.with_overrides(
+        optimizer={"scale_lr_by_data_parallel": True}, mesh={"data_parallel": 4}
+    )
+    # sanity: builds without error and still trains
+    state = create_train_state(cfg)
+    data = _synthetic_learnable(jax.random.PRNGKey(2), 256, cfg)
+    step = jax.jit(make_train_step(cfg))
+    state, m = step(state, data)
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_train_step_donation_compatible():
+    """State pytree round-trips through jit with donated buffers."""
+    train_step = jax.jit(make_train_step(CFG), donate_argnums=(0,))
+    state = create_train_state(CFG)
+    data = _synthetic_learnable(jax.random.PRNGKey(3), 256, CFG)
+    state, _ = train_step(state, data)
+    state, _ = train_step(state, data)
+    assert int(state.step) == 2
