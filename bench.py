@@ -1,0 +1,109 @@
+"""End-of-round benchmark: DeepFM training throughput on one chip.
+
+Prints exactly ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Config matches the reference notebook's training job (ps notebook cell 4:
+batch 1024, feature_size 117,581, field 39, K=32, deep 128/64/32, Adam 5e-4)
+with bf16 MXU compute.  The reference publishes no absolute throughput
+(BASELINE.md), so ``vs_baseline`` is normalized against the BASELINE.json
+north-star target expressed per chip: 1M examples/sec aggregate on a v5e-64
+=> 15,625 examples/sec/chip.  vs_baseline = measured / 15625 (>1.0 beats the
+per-chip north-star rate).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+NORTH_STAR_PER_CHIP = 1_000_000 / 64  # examples/sec/chip
+
+
+def main() -> None:
+    from deepfm_tpu.core.platform import sanitize_backend
+
+    sanitize_backend()
+    import jax
+
+    platform = jax.devices()[0].platform
+    from deepfm_tpu.core.config import Config
+    from deepfm_tpu.train import create_train_state, make_train_step
+
+    cfg = Config.from_dict(
+        {
+            "model": {
+                "feature_size": 117_581,
+                "field_size": 39,
+                "embedding_size": 32,
+                "deep_layers": (128, 64, 32),
+                "dropout_keep": (0.5, 0.5, 0.5),
+            },
+            "optimizer": {"learning_rate": 0.0005},
+            "data": {"batch_size": 1024},
+        }
+    )
+    batch_size = cfg.data.batch_size
+    state = create_train_state(cfg)
+    train_step = jax.jit(make_train_step(cfg), donate_argnums=(0,))
+
+    # synthetic Criteo-shaped batches (13 numeric + 26 skewed categorical),
+    # pre-staged on device so the bench isolates the training-step rate
+    rng = np.random.default_rng(0)
+    nb = 8
+    batches = []
+    for _ in range(nb):
+        numeric = rng.integers(1, 14, size=(batch_size, 13))
+        cat = 14 + (rng.zipf(1.3, size=(batch_size, 26)) % (117_581 - 14))
+        ids = np.concatenate([numeric, cat], axis=1).astype(np.int64)
+        vals = np.concatenate(
+            [rng.random((batch_size, 13), dtype=np.float32),
+             np.ones((batch_size, 26), dtype=np.float32)], axis=1
+        )
+        labels = (rng.random(batch_size) < 0.25).astype(np.float32)
+        batches.append(
+            {
+                "feat_ids": jax.device_put(ids),
+                "feat_vals": jax.device_put(vals),
+                "label": jax.device_put(labels),
+            }
+        )
+
+    # warmup (compile + first dispatches)
+    for i in range(3):
+        state, metrics = train_step(state, batches[i % nb])
+    jax.block_until_ready(metrics)
+
+    steps = 100
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, metrics = train_step(state, batches[i % nb])
+    jax.block_until_ready(metrics)
+    dt = time.perf_counter() - t0
+
+    examples_per_sec = steps * batch_size / dt
+    result = {
+        "metric": "deepfm_train_examples_per_sec_per_chip",
+        "value": round(examples_per_sec, 1),
+        "unit": "examples/s",
+        "vs_baseline": round(examples_per_sec / NORTH_STAR_PER_CHIP, 3),
+        "platform": platform,
+        "batch_size": batch_size,
+        "steps": steps,
+        "step_ms": round(1000 * dt / steps, 3),
+        "final_loss": round(float(metrics["loss"]), 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # still emit one parseable line on failure
+        print(json.dumps({"metric": "deepfm_train_examples_per_sec_per_chip",
+                          "value": 0, "unit": "examples/s", "vs_baseline": 0,
+                          "error": f"{type(e).__name__}: {e}"[:300]}))
+        sys.exit(1)

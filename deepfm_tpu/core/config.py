@@ -104,6 +104,9 @@ class DataConfig:
     evaluation_channel_name: str = "evaluation"
     prefetch_batches: int = 2         # double-buffered host->device feed
     file_patterns: tuple[str, ...] = ("tr", "train")
+    # spread Zipf-hot ids across embedding shards with a fixed bijective
+    # permutation (host-side, parallel/embedding.permute_ids)
+    permute_ids: bool = False
 
 
 @dataclass(frozen=True)

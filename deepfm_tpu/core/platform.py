@@ -1,0 +1,37 @@
+"""Backend sanitation for environments with auto-registered PJRT plugins.
+
+Some images install a sitecustomize that registers an experimental tunneled
+TPU backend ("axon") in every Python process and hooks jax's backend lookup;
+its device-attach blocks for minutes (or forever) even when the caller
+explicitly requested CPU via ``JAX_PLATFORMS=cpu``.  Calling
+``sanitize_backend()`` before the first jax backend initialization makes the
+requested platform authoritative: if the request does not include the
+tunneled plugin, its factory is deregistered so nothing can dial it.
+"""
+
+from __future__ import annotations
+
+import os
+
+_TUNNEL_PLATFORMS = ("axon",)
+
+
+def sanitize_backend() -> None:
+    requested = os.environ.get("JAX_PLATFORMS", "")
+    if any(p in requested for p in _TUNNEL_PLATFORMS):
+        return  # the tunnel was explicitly requested; leave it alone
+    try:
+        import jax
+
+        if requested:
+            # effective even if jax was imported (and env read) earlier
+            jax.config.update("jax_platforms", requested)
+            # the tunnel plugin hooks jax's backend lookup, so the config
+            # update alone is insufficient — remove its factory whenever the
+            # explicit request does not name it
+            from jax._src import xla_bridge as xb
+
+            for p in _TUNNEL_PLATFORMS:
+                xb._backend_factories.pop(p, None)
+    except Exception:
+        pass  # never make startup worse than the status quo

@@ -1,0 +1,17 @@
+from .embedding import (  # noqa: F401
+    make_sharded_lookup_fn,
+    permute_ids,
+    sharded_l2,
+    sharded_lookup,
+)
+from .mesh import DATA_AXIS, MODEL_AXIS, build_mesh, initialize_distributed, mesh_shape  # noqa: F401
+from .spmd import (  # noqa: F401
+    SPMDContext,
+    create_spmd_state,
+    make_context,
+    make_spmd_eval_step,
+    make_spmd_predict_step,
+    make_spmd_train_step,
+    padded_vocab,
+    shard_batch,
+)

@@ -1,0 +1,86 @@
+"""Row-sharded embedding tables — the parameter-server capability, TPU-native.
+
+The reference scales its 117k-row (100M-row at the north star) FM_W/FM_V
+tables by placing them on parameter servers and pulling rows over grpc every
+step (README.md:15,63; SURVEY §2b).  Here the tables are row-sharded across
+the mesh's ``model`` axis and lookups happen *on-device*:
+
+    shard j owns rows [j·V/M, (j+1)·V/M)
+    every shard gathers the ids it owns (others contribute zeros)
+    psum over the model axis assembles full rows on every shard
+
+The psum rides ICI; backward of the masked local gather is a local
+scatter-add — exactly the sparse-gradient push of a PS, without a server.
+These functions are written for use **inside ``shard_map``** (they call
+``lax.psum`` / ``lax.axis_index``); the single-chip dense path stays
+``ops.embedding.dense_lookup``.
+
+Load-balance note (SURVEY §7 hard part (a)): Criteo ids are Zipf-skewed, and
+row-sharding by contiguous range keeps hot numeric ids (low ids) on shard 0.
+``permute_ids`` applies a fixed bijective multiplicative-hash permutation to
+spread hot rows across shards; the input pipeline applies it when
+``DataConfig.permute_ids`` is set (see deepfm_tpu/data/pipeline.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .mesh import MODEL_AXIS
+
+# odd multiplier for the bijective id-spreading permutation (Knuth-style)
+_HASH_MULT = 0x9E3779B1
+
+
+def permute_ids(ids, vocab_size: int, enabled: bool) -> np.ndarray:
+    """Bijective multiplicative-hash permutation of ids within [0, vocab) to
+    spread Zipf-hot rows across shards.  Host-side (numpy int64) — applied in
+    the input pipeline before device transfer, so the on-device lookup stays
+    a plain range shard."""
+    ids = np.asarray(ids)
+    if not enabled:
+        return ids
+    mult = _HASH_MULT
+    while np.gcd(mult, vocab_size) != 1:  # bijectivity needs gcd(a, V) == 1
+        mult += 2
+    return (ids.astype(np.int64) * mult) % vocab_size
+
+
+def sharded_lookup(
+    local_table: jnp.ndarray,
+    ids: jnp.ndarray,
+    *,
+    axis_name: str = MODEL_AXIS,
+) -> jnp.ndarray:
+    """Gather rows from a row-sharded table, inside shard_map.
+
+    local_table: this shard's rows — [V/M] or [V/M, K]
+    ids: global ids [B, F] (replicated across the model axis)
+    returns: full rows [B, F] or [B, F, K] (replicated across the model axis)
+    """
+    rows = local_table.shape[0]
+    shard = lax.axis_index(axis_name)
+    lo = shard * rows
+    local_ids = ids - lo
+    in_range = (local_ids >= 0) & (local_ids < rows)
+    gathered = jnp.take(local_table, jnp.clip(local_ids, 0, rows - 1), axis=0)
+    mask = in_range if gathered.ndim == ids.ndim else in_range[..., None]
+    gathered = jnp.where(mask, gathered, 0)
+    return lax.psum(gathered, axis_name)
+
+
+def sharded_l2(local_table: jnp.ndarray, axis_name: str = MODEL_AXIS) -> jnp.ndarray:
+    """``l2_loss`` over a row-sharded table: ½·psum(Σ local²)."""
+    return 0.5 * lax.psum(jnp.sum(jnp.square(local_table)), axis_name)
+
+
+def make_sharded_lookup_fn(axis_name: str = MODEL_AXIS):
+    """A ``lookup_fn`` for model.apply, closing over the axis name."""
+
+    def lookup(table: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
+        return sharded_lookup(table, ids, axis_name=axis_name)
+
+    return lookup

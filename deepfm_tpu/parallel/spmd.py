@@ -1,0 +1,337 @@
+"""SPMD train/eval/predict over a [data × model] mesh.
+
+This is the distributed heart of the framework, replacing both reference
+comm stacks at once (SURVEY §2b, §5):
+
+* **sync data parallelism** (the Horovod path, hvd:171/296/418): the batch is
+  sharded over the ``data`` axis; gradients are ``pmean``-reduced across it —
+  XLA emits the allreduce over ICI, no Horovod/NCCL.
+* **parameter sharding** (the PS path, README.md:15,63): FM_W/FM_V are
+  row-sharded over the ``model`` axis; lookups assemble rows with an on-graph
+  psum (parallel/embedding.py); gradient scatter-adds stay shard-local.
+  Broadcast-consistent init (hvd:417-418) is free: one PRNG key, one sharded
+  init executable, identical replicas by construction.
+
+The whole train step — forward, backward, collectives, optimizer — is a
+single ``shard_map``-ped, jitted XLA executable with donated state buffers.
+
+Vocab padding: row-sharding needs ``vocab % model_parallel == 0``, so tables
+are padded up to the next multiple; pad rows are zero-initialized, never
+looked up (ids < true vocab), and excluded from nothing — their L2 decay is
+the only (infinitesimal) effect.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..core.config import Config
+from ..models.base import get_model
+from ..ops.auc import AUCState, auc_init, auc_update
+from ..train.optimizer import build_optimizer
+from ..train.step import TrainState, sigmoid_cross_entropy
+from .embedding import make_sharded_lookup_fn, sharded_l2
+from .mesh import DATA_AXIS, MODEL_AXIS, mesh_shape
+
+# params keys treated as row-sharded embedding tables (must match the model
+# families' table naming and ModelDef.l2_penalty conventions)
+TABLE_KEYS = ("fm_w", "fm_v", "embedding")
+
+
+class SPMDContext(NamedTuple):
+    """Everything needed to run sharded steps: the padded config, mesh, and
+    the sharding pytrees for state and batches."""
+
+    cfg: Config                 # with feature_size padded for the mesh
+    true_feature_size: int      # pre-padding vocab (for data validation)
+    mesh: Mesh
+    state_specs: Any            # PartitionSpec pytree matching TrainState
+    state_shardings: Any        # NamedSharding pytree matching TrainState
+    batch_specs: Any
+    batch_shardings: Any
+
+
+def padded_vocab(feature_size: int, model_parallel: int) -> int:
+    return -(-feature_size // model_parallel) * model_parallel
+
+
+def _spec_for_leaf(path, shape: tuple[int, ...], vocab: int) -> P:
+    """Row-shard exactly the leaves living under a TABLE_KEYS dict key whose
+    leading dim is the (padded) vocab — this covers the params and their
+    optimizer-state moments (optax states mirror the param tree, so the same
+    dict keys appear in their paths).  Path-based matching cannot collide
+    with an MLP kernel that happens to share a dimension."""
+    keys = {getattr(p, "key", None) for p in path}
+    if keys & set(TABLE_KEYS) and len(shape) >= 1 and shape[0] == vocab:
+        return P(MODEL_AXIS, *([None] * (len(shape) - 1)))
+    return P()
+
+
+def _build_full_init(cfg: Config, true_vocab: int) -> Callable:
+    """Initializer for the full TrainState with zeroed pad rows."""
+    model = get_model(cfg.model)
+    tx = build_optimizer(cfg.optimizer, data_parallel_size=cfg.mesh.data_parallel)
+
+    def init_fn(key: jax.Array) -> TrainState:
+        init_key, step_key = jax.random.split(key)
+        params, model_state = model.init(init_key, cfg.model)
+        for k in TABLE_KEYS:
+            if k in params:
+                rows = jnp.arange(params[k].shape[0])
+                keep = rows < true_vocab
+                mask = keep if params[k].ndim == 1 else keep[:, None]
+                params[k] = jnp.where(mask, params[k], 0)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            model_state=model_state,
+            opt_state=tx.init(params),
+            rng=step_key,
+        )
+
+    return init_fn
+
+
+def make_context(cfg: Config, mesh: Mesh) -> SPMDContext:
+    """Compute sharding specs for the TrainState via shape inference only —
+    no parameter materialization (the 100M-vocab table never touches a host)."""
+    dp, mp = mesh_shape(mesh)
+    true_vocab = cfg.model.feature_size
+    pv = padded_vocab(true_vocab, mp)
+    cfg = cfg.with_overrides(
+        model={"feature_size": pv},
+        mesh={"data_parallel": dp, "model_parallel": mp},
+    )
+    init_fn = _build_full_init(cfg, true_vocab)
+    shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    state_specs = jax.tree_util.tree_map_with_path(
+        lambda p, s: _spec_for_leaf(p, s.shape, pv), shapes
+    )
+    state_shardings = jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), state_specs
+    )
+    batch_specs = {
+        "feat_ids": P(DATA_AXIS, None),
+        "feat_vals": P(DATA_AXIS, None),
+        "label": P(DATA_AXIS),
+    }
+    batch_shardings = jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), batch_specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return SPMDContext(
+        cfg, true_vocab, mesh, state_specs, state_shardings, batch_specs,
+        batch_shardings,
+    )
+
+
+def create_spmd_state(ctx: SPMDContext, key: jax.Array | None = None) -> TrainState:
+    """Initialize the TrainState directly into its shardings: XLA materializes
+    each table shard on its own device (deterministic across replicas — the
+    BroadcastGlobalVariablesHook capability, hvd:417-418, by construction)."""
+    key = jax.random.PRNGKey(ctx.cfg.run.seed) if key is None else key
+    init_fn = _build_full_init(ctx.cfg, ctx.true_feature_size)
+    with ctx.mesh:
+        return jax.jit(init_fn, out_shardings=ctx.state_shardings)(key)
+
+
+def _sharded_penalty(params: dict, l2_reg: float) -> jnp.ndarray:
+    """Reference loss regularizer (ps:275-279) over row-sharded tables:
+    ½·psum_model(Σ local²) per table.  Mirrors ModelDef.l2_penalty's
+    TABLE_KEYS convention for the sharded case."""
+    total = jnp.zeros(())
+    for k in TABLE_KEYS:
+        if k in params:
+            total = total + sharded_l2(params[k])
+    return l2_reg * total
+
+
+def _pmean_grads(grads: dict) -> dict:
+    """Sync gradients: every leaf pmean-ed over the data axis (the Horovod
+    DistributedOptimizer capability, hvd:296); replicated (non-table) leaves
+    additionally pmean-ed over the model axis — numerically a no-op since
+    model replicas see identical batches, but it keeps replicas bit-identical
+    regardless of reduction order."""
+
+    def sync_entry(path, g):
+        g = lax.pmean(g, DATA_AXIS)
+        top = getattr(path[0], "key", None) if path else None
+        if top not in TABLE_KEYS:
+            g = lax.pmean(g, MODEL_AXIS)
+        return g
+
+    return jax.tree_util.tree_map_with_path(sync_entry, grads)
+
+
+def _local_loss(cfg: Config, model, params, model_state, batch, rng, train):
+    lookup = make_sharded_lookup_fn()
+    logits, new_state = model.apply(
+        params,
+        model_state,
+        batch["feat_ids"],
+        batch["feat_vals"],
+        cfg=cfg.model,
+        train=train,
+        rng=rng,
+        lookup_fn=lookup,
+    )
+    labels = batch["label"].reshape(-1).astype(jnp.float32)
+    ce = jnp.mean(sigmoid_cross_entropy(logits, labels))
+    loss = ce + _sharded_penalty(params, cfg.model.l2_reg)
+    return loss, (logits, new_state)
+
+
+def make_spmd_train_step(ctx: SPMDContext, *, donate: bool = True) -> Callable:
+    """``(state, batch) -> (state, metrics)`` — fully sharded and jitted.
+
+    The batch must be globally-batched arrays placed with
+    ``ctx.batch_shardings`` (see ``shard_batch``).
+    """
+    cfg = ctx.cfg
+    model = get_model(cfg.model)
+    tx = build_optimizer(cfg.optimizer, data_parallel_size=cfg.mesh.data_parallel)
+
+    def local_step(state: TrainState, batch: dict):
+        # distinct dropout mask per data shard, identical across model shards
+        step_rng = jax.random.fold_in(state.rng, state.step)
+        step_rng = jax.random.fold_in(step_rng, lax.axis_index(DATA_AXIS))
+
+        def loss_fn(params):
+            return _local_loss(
+                cfg, model, params, state.model_state, batch, step_rng, True
+            )
+
+        (loss, (logits, new_model_state)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        grads = _pmean_grads(grads)
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {
+            "loss": lax.pmean(loss, DATA_AXIS),
+            "pred_mean": lax.pmean(jnp.mean(jax.nn.sigmoid(logits)), DATA_AXIS),
+            "label_mean": lax.pmean(
+                jnp.mean(batch["label"].astype(jnp.float32)), DATA_AXIS
+            ),
+            # per-data-shard local loss, [dp] — observability into shard skew
+            # (and the per-shard dropout-mask invariant, see tests)
+            "loss_per_shard": loss[None],
+        }
+        new_state = TrainState(
+            step=state.step + 1,
+            params=new_params,
+            model_state=new_model_state,
+            opt_state=new_opt_state,
+            rng=state.rng,
+        )
+        return new_state, metrics
+
+    metric_specs = {
+        "loss": P(),
+        "pred_mean": P(),
+        "label_mean": P(),
+        "loss_per_shard": P(DATA_AXIS),
+    }
+    mapped = shard_map(
+        local_step,
+        mesh=ctx.mesh,
+        in_specs=(ctx.state_specs, ctx.batch_specs),
+        out_specs=(ctx.state_specs, metric_specs),
+        check_vma=False,  # grads of psum-assembled lookups defeat replication checking
+    )
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def make_spmd_eval_step(ctx: SPMDContext) -> Callable:
+    """``(state, auc_state, batch) -> (auc_state, metrics)`` with confusion
+    counts psum-merged across the data axis (ops.auc counts are additive)."""
+    cfg = ctx.cfg
+    model = get_model(cfg.model)
+
+    def local_eval(state: TrainState, auc_state: AUCState, batch: dict):
+        loss, (logits, _) = _local_loss(
+            cfg, model, state.params, state.model_state, batch, None, False
+        )
+        preds = jax.nn.sigmoid(logits)
+        labels = batch["label"].reshape(-1)
+        local_counts = auc_update(
+            auc_init(auc_state.num_thresholds), labels, preds
+        ).counts
+        new_counts = auc_state.counts + lax.psum(local_counts, DATA_AXIS)
+        count = lax.psum(jnp.asarray(labels.shape[0]), DATA_AXIS)
+        return AUCState(new_counts), {
+            "loss": lax.pmean(loss, DATA_AXIS),
+            "count": count,
+        }
+
+    auc_specs = AUCState(P())
+    mapped = shard_map(
+        local_eval,
+        mesh=ctx.mesh,
+        in_specs=(ctx.state_specs, auc_specs, ctx.batch_specs),
+        out_specs=(auc_specs, {"loss": P(), "count": P()}),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+def make_spmd_predict_step(ctx: SPMDContext) -> Callable:
+    """``(state, batch) -> prob [B]``, probabilities sharded over data."""
+    cfg = ctx.cfg
+    model = get_model(cfg.model)
+
+    def local_predict(state: TrainState, batch: dict):
+        logits, _ = model.apply(
+            state.params,
+            state.model_state,
+            batch["feat_ids"],
+            batch["feat_vals"],
+            cfg=cfg.model,
+            train=False,
+            rng=None,
+            lookup_fn=make_sharded_lookup_fn(),
+        )
+        return jax.nn.sigmoid(logits)
+
+    mapped = shard_map(
+        local_predict,
+        mesh=ctx.mesh,
+        in_specs=(ctx.state_specs, ctx.batch_specs),
+        out_specs=P(DATA_AXIS),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+def shard_batch(ctx: SPMDContext, batch: dict, *, validate_ids: bool = True) -> dict:
+    """Place a global host batch onto the mesh (data-sharded, model-replicated).
+
+    Batch size must be divisible by the data-parallel degree.  Ids are
+    range-checked against the TRUE vocab by default: out-of-range ids behave
+    differently sharded (masked to zero rows) than dense (clipped), and ids
+    in the padding range would silently train pad rows — fail loudly instead.
+    Set ``validate_ids=False`` on a hot path that has already validated.
+    """
+    dp, _ = mesh_shape(ctx.mesh)
+    b = batch["label"].shape[0]
+    if b % dp != 0:
+        raise ValueError(f"global batch {b} not divisible by data_parallel {dp}")
+    if validate_ids and "feat_ids" in batch:
+        import numpy as np
+
+        ids = np.asarray(batch["feat_ids"])
+        if ids.size and (ids.min() < 0 or ids.max() >= ctx.true_feature_size):
+            raise ValueError(
+                f"feat_ids out of range [0, {ctx.true_feature_size}): "
+                f"min={ids.min()} max={ids.max()}"
+            )
+    return {
+        k: jax.device_put(batch[k], ctx.batch_shardings[k]) for k in batch
+    }
