@@ -1,0 +1,1 @@
+from .ckpt import Checkpointer, maybe_clear  # noqa: F401

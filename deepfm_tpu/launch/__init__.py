@@ -1,0 +1,3 @@
+# NOTE: deliberately no re-export of .cli here — `python -m
+# deepfm_tpu.launch.cli` would warn about the module pre-existing in
+# sys.modules if the package imported it eagerly.

@@ -1,0 +1,149 @@
+"""Launcher CLI — the notebook/SageMaker-Estimator capability (SURVEY §2a
+rows 11-12) as a command line.
+
+The reference's launch stack was: notebook hyperparameters dict -> SageMaker
+serializes to CLI args -> tf.app.flags (ps:37-107) with env-derived defaults.
+Here: one CLI with (1) a JSON config file, (2) dotted ``--set section.key=
+value`` overrides, (3) platform env folding (SM_HOSTS/SM_CURRENT_HOST or
+DEEPFM_* — Config.from_env), applied in that order, then task dispatch.
+
+Multi-host: run one process per host with DEEPFM_COORDINATOR /
+DEEPFM_NUM_PROCESSES / DEEPFM_PROCESS_ID set (the mpirun analog, §2b row 5).
+
+Usage:
+    python -m deepfm_tpu.launch.cli --task_type train \
+        --training_data_dir data/ --val_data_dir data/ \
+        --model_dir /tmp/model --set model.embedding_size=32
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..core.config import Config
+from ..core.platform import sanitize_backend
+
+
+def _coerce(value: str):
+    try:
+        return json.loads(value)
+    except json.JSONDecodeError:
+        return value
+
+
+def apply_set_overrides(cfg: Config, pairs: list[str]) -> Config:
+    sections: dict[str, dict] = {}
+    for pair in pairs:
+        if "=" not in pair or "." not in pair.split("=", 1)[0]:
+            raise SystemExit(
+                f"--set expects section.key=value, got {pair!r} "
+                f"(sections: model, optimizer, data, mesh, run)"
+            )
+        key, value = pair.split("=", 1)
+        section, field = key.split(".", 1)
+        sections.setdefault(section, {})[field] = _coerce(value)
+    try:
+        return cfg.with_overrides(**sections)
+    except TypeError as e:
+        raise SystemExit(f"bad --set override: {e}") from None
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="deepfm-tpu",
+        description="TPU-native DeepFM distributed training launcher",
+    )
+    p.add_argument("--config", help="JSON config file (Config.to_dict schema)")
+    p.add_argument(
+        "--task_type",
+        choices=["train", "eval", "infer", "export"],
+        help="task dispatch (reference ps:77-79)",
+    )
+    # the high-traffic flags get first-class spellings (parity with the
+    # reference's most-used hyperparameters, ps nb cell 4)
+    p.add_argument("--training_data_dir")
+    p.add_argument("--val_data_dir")
+    p.add_argument("--test_data_dir")
+    p.add_argument("--model_dir")
+    p.add_argument("--servable_model_dir")
+    p.add_argument("--batch_size", type=int)
+    p.add_argument("--num_epochs", type=int)
+    p.add_argument("--learning_rate", type=float)
+    p.add_argument("--feature_size", type=int)
+    p.add_argument("--field_size", type=int)
+    p.add_argument("--embedding_size", type=int)
+    p.add_argument("--deep_layers", help='e.g. "128,64,32"')
+    p.add_argument("--dropout", help='keep probabilities, e.g. "0.5,0.5,0.5"')
+    p.add_argument("--optimizer", help="Adam|Adagrad|Momentum|Ftrl")
+    p.add_argument("--model_name", help="deepfm|xdeepfm|dcnv2|two_tower")
+    p.add_argument("--data_parallel", type=int)
+    p.add_argument("--model_parallel", type=int)
+    p.add_argument(
+        "--set",
+        action="append",
+        default=[],
+        metavar="SECTION.KEY=VALUE",
+        help="override any config field, e.g. --set model.batch_norm=true",
+    )
+    p.add_argument("--no_env", action="store_true", help="skip platform env folding")
+    p.add_argument(
+        "--print_config", action="store_true", help="print resolved config and exit"
+    )
+    return p
+
+
+_FLAG_MAP = {
+    "task_type": ("run", "task_type"),
+    "training_data_dir": ("data", "training_data_dir"),
+    "val_data_dir": ("data", "val_data_dir"),
+    "test_data_dir": ("data", "test_data_dir"),
+    "model_dir": ("run", "model_dir"),
+    "servable_model_dir": ("run", "servable_model_dir"),
+    "batch_size": ("data", "batch_size"),
+    "num_epochs": ("data", "num_epochs"),
+    "learning_rate": ("optimizer", "learning_rate"),
+    "feature_size": ("model", "feature_size"),
+    "field_size": ("model", "field_size"),
+    "embedding_size": ("model", "embedding_size"),
+    "deep_layers": ("model", "deep_layers"),
+    "dropout": ("model", "dropout_keep"),
+    "optimizer": ("optimizer", "name"),
+    "model_name": ("model", "model_name"),
+    "data_parallel": ("mesh", "data_parallel"),
+    "model_parallel": ("mesh", "model_parallel"),
+}
+
+
+def resolve_config(argv: list[str] | None = None) -> tuple[Config, argparse.Namespace]:
+    args = build_parser().parse_args(argv)
+    cfg = Config.from_json(args.config) if args.config else Config()
+    sections: dict[str, dict] = {}
+    for flag, (section, field) in _FLAG_MAP.items():
+        value = getattr(args, flag)
+        if value is not None:
+            sections.setdefault(section, {})[field] = value
+    if sections:
+        cfg = cfg.with_overrides(**sections)
+    if args.set:
+        cfg = apply_set_overrides(cfg, args.set)
+    if not args.no_env:
+        cfg = Config.from_env(cfg)
+    return cfg, args
+
+
+def main(argv: list[str] | None = None) -> int:
+    cfg, args = resolve_config(argv)
+    if args.print_config:
+        print(json.dumps(cfg.to_dict(), indent=2))
+        return 0
+    sanitize_backend()
+    from ..train.loop import run_task
+
+    run_task(cfg)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -126,6 +126,9 @@ def make_context(cfg: Config, mesh: Mesh) -> SPMDContext:
         lambda spec: NamedSharding(mesh, spec), batch_specs,
         is_leaf=lambda x: isinstance(x, P),
     )
+    # eval-only optional field (not part of batch_specs: train steps never
+    # receive it, and shard_map in_specs must match the pytree exactly)
+    batch_shardings["weight"] = NamedSharding(mesh, P(DATA_AXIS))
     return SPMDContext(
         cfg, true_vocab, mesh, state_specs, state_shardings, batch_specs,
         batch_shardings,
@@ -251,35 +254,61 @@ def make_spmd_train_step(ctx: SPMDContext, *, donate: bool = True) -> Callable:
 
 def make_spmd_eval_step(ctx: SPMDContext) -> Callable:
     """``(state, auc_state, batch) -> (auc_state, metrics)`` with confusion
-    counts psum-merged across the data axis (ops.auc counts are additive)."""
+    counts psum-merged across the data axis (ops.auc counts are additive).
+
+    The batch may carry an optional ``weight`` field ([B] f32): zero-weight
+    rows contribute nothing to AUC counts, loss, or the example count — how
+    tail batches padded up to the data-parallel multiple stay exact.
+    """
     cfg = ctx.cfg
     model = get_model(cfg.model)
 
     def local_eval(state: TrainState, auc_state: AUCState, batch: dict):
-        loss, (logits, _) = _local_loss(
-            cfg, model, state.params, state.model_state, batch, None, False
+        weight = batch.get("weight")
+        model_batch = {k: v for k, v in batch.items() if k != "weight"}
+        _, (logits, _) = _local_loss(
+            cfg, model, state.params, state.model_state, model_batch, None, False
         )
+        labels = batch["label"].reshape(-1).astype(jnp.float32)
+        w = jnp.ones_like(labels) if weight is None else weight.reshape(-1)
+        ce = sigmoid_cross_entropy(logits, labels)
+        loss_sum = lax.psum(jnp.sum(ce * w), DATA_AXIS)
+        w_sum = lax.psum(jnp.sum(w), DATA_AXIS)
+        penalty = _sharded_penalty(state.params, cfg.model.l2_reg)
         preds = jax.nn.sigmoid(logits)
-        labels = batch["label"].reshape(-1)
         local_counts = auc_update(
-            auc_init(auc_state.num_thresholds), labels, preds
+            auc_init(auc_state.num_thresholds), labels, preds, weights=w
         ).counts
         new_counts = auc_state.counts + lax.psum(local_counts, DATA_AXIS)
-        count = lax.psum(jnp.asarray(labels.shape[0]), DATA_AXIS)
         return AUCState(new_counts), {
-            "loss": lax.pmean(loss, DATA_AXIS),
-            "count": count,
+            "loss": loss_sum / jnp.maximum(w_sum, 1.0) + penalty,
+            "count": w_sum,
         }
 
     auc_specs = AUCState(P())
-    mapped = shard_map(
-        local_eval,
-        mesh=ctx.mesh,
-        in_specs=(ctx.state_specs, auc_specs, ctx.batch_specs),
-        out_specs=(auc_specs, {"loss": P(), "count": P()}),
-        check_vma=False,
-    )
-    return jax.jit(mapped)
+
+    def build(with_weight: bool):
+        specs = dict(ctx.batch_specs)
+        if with_weight:
+            specs["weight"] = P(DATA_AXIS)
+        return jax.jit(
+            shard_map(
+                local_eval,
+                mesh=ctx.mesh,
+                in_specs=(ctx.state_specs, auc_specs, specs),
+                out_specs=(auc_specs, {"loss": P(), "count": P()}),
+                check_vma=False,
+            )
+        )
+
+    weighted = build(True)
+    unweighted = build(False)
+
+    def eval_step(state, auc_state, batch):
+        fn = weighted if "weight" in batch else unweighted
+        return fn(state, auc_state, batch)
+
+    return eval_step
 
 
 def make_spmd_predict_step(ctx: SPMDContext) -> Callable:

@@ -1,0 +1,1 @@
+from .export import export_servable, load_servable, write_predictions  # noqa: F401

@@ -1,0 +1,105 @@
+"""Export + batch inference — the SavedModel/TF-Serving capability.
+
+The reference exports a SavedModel with a raw-tensor serving signature
+(``feat_ids`` int64 [None, F], ``feat_vals`` float [None, F] -> ``prob``;
+ps:535-551) from hosts[0]/rank 0 only, and its ``infer`` task streams
+probabilities to ``pred.txt`` (ps:526-533).
+
+The servable here is a directory artifact:
+    servable/
+      config.json        — full framework Config (the signature's shape info)
+      params/            — Orbax checkpoint of (params, model_state)
+Loading returns a jitted ``predict(feat_ids, feat_vals) -> prob`` closure —
+the serving signature as an XLA executable rather than a TF graph.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Iterator
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from ..core.config import Config
+from ..models.base import get_model
+from ..train.step import TrainState
+
+
+def export_servable(
+    cfg: Config, state: TrainState, directory: str | os.PathLike
+) -> str:
+    """Write the servable artifact.
+
+    The reference exports from hosts[0]/rank 0 only (ps:548, hvd:475-493) to
+    avoid concurrent writers.  Here the Orbax save is a *collective*: in a
+    multi-host run every process must call it (each serializes only its
+    addressable shards; Orbax coordinates one atomic directory), so all
+    processes enter; only process 0 writes the small config.json.
+    """
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    if jax.process_index() == 0:
+        with open(os.path.join(directory, "config.json"), "w") as f:
+            json.dump(cfg.to_dict(), f, indent=2)
+    ckptr = ocp.StandardCheckpointer()
+    payload = {"params": state.params, "model_state": state.model_state}
+    path = os.path.join(directory, "params")
+    ckptr.save(path, payload, force=True)
+    ckptr.wait_until_finished()
+    ckptr.close()
+    return directory
+
+
+def load_servable(directory: str | os.PathLike) -> tuple[Callable, Config]:
+    """Load a servable and return (jitted predict fn, config).
+
+    predict(feat_ids [B, F] int, feat_vals [B, F] f32) -> prob [B] f32 —
+    the reference's serving signature (ps:538-547).
+    """
+    directory = os.path.abspath(directory)
+    with open(os.path.join(directory, "config.json")) as f:
+        cfg = Config.from_dict(json.load(f))
+    model = get_model(cfg.model)
+    # restore against the abstract structure implied by the config — shape-
+    # safe (and silences orbax's no-target warning)
+    abstract_params, abstract_state = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), cfg.model)
+    )
+    device = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    abstract_params, abstract_state = jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=device),
+        (abstract_params, abstract_state),
+    )
+    ckptr = ocp.StandardCheckpointer()
+    payload = ckptr.restore(
+        os.path.join(directory, "params"),
+        {"params": abstract_params, "model_state": abstract_state},
+    )
+    ckptr.close()
+    params, model_state = payload["params"], payload["model_state"]
+
+    @jax.jit
+    def predict(feat_ids, feat_vals):
+        logits, _ = model.apply(
+            params, model_state, feat_ids, feat_vals, cfg=cfg.model, train=False
+        )
+        return jax.nn.sigmoid(logits)
+
+    return predict, cfg
+
+
+def write_predictions(
+    probs: Iterator[np.ndarray] | Iterator[float], path: str | os.PathLike
+) -> int:
+    """The ``infer``-task output: one probability per line (ps:526-533)."""
+    count = 0
+    with open(path, "w") as f:
+        for p in probs:
+            arr = np.atleast_1d(np.asarray(p))
+            for v in arr:
+                f.write(f"{float(v):.6f}\n")
+                count += 1
+    return count

@@ -1,0 +1,229 @@
+"""Training driver: the task dispatcher (train/eval/infer/export) over the
+SPMD machinery — the ``main()`` capability of the reference scripts
+(ps:389-556, hvd:331-493) without sessions, hooks, or Estimator.
+
+The ``train`` task runs the epoch loop with periodic structured logging
+(log_steps), periodic checkpointing, optional jax.profiler traces, resume-
+from-latest on startup (the spot-restart capability, SURVEY §5), end-of-
+training eval, and a final export — mirroring the reference's
+train_and_evaluate + export flow (ps:501-521, 535-551).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator
+
+import jax
+import numpy as np
+
+from ..checkpoint import Checkpointer, maybe_clear
+from ..core.config import Config
+from ..data.pipeline import DevicePrefetcher, InMemoryDataset, discover_files, make_input_pipeline
+from ..data.sharding import WorkerTopology
+from ..ops.auc import auc_value
+from ..parallel import (
+    SPMDContext,
+    build_mesh,
+    create_spmd_state,
+    initialize_distributed,
+    make_context,
+    make_spmd_eval_step,
+    make_spmd_predict_step,
+    make_spmd_train_step,
+    shard_batch,
+)
+from ..serve import export_servable, write_predictions
+from ..train.step import TrainState
+from ..utils import MetricLogger
+from .step import new_auc_state
+
+
+def worker_topology(cfg: Config) -> WorkerTopology:
+    return WorkerTopology(
+        num_hosts=cfg.run.num_hosts,
+        host_rank=cfg.run.host_rank,
+        workers_per_host=cfg.run.workers_per_host,
+        local_rank=0,  # one process per host in the JAX runtime model
+    )
+
+
+def setup(cfg: Config) -> SPMDContext:
+    initialize_distributed(cfg.mesh)
+    mesh = build_mesh(cfg.mesh)
+    return make_context(cfg, mesh)
+
+
+def _train_batches(cfg: Config, ctx: SPMDContext) -> DevicePrefetcher:
+    topo = worker_topology(cfg)
+    batches = make_input_pipeline(
+        cfg.data,
+        topo,
+        field_size=cfg.model.field_size,
+        channel=cfg.data.training_channel_name,
+        data_dir=cfg.data.training_data_dir,
+        feature_size=ctx.true_feature_size,
+        seed=cfg.run.seed,
+    )
+    return DevicePrefetcher(
+        batches, lambda b: shard_batch(ctx, b), depth=cfg.data.prefetch_batches
+    )
+
+
+def _padded_batches(
+    ds: InMemoryDataset, batch_size: int, dp: int
+) -> Iterator[tuple[dict, int]]:
+    """Batches including the tail, padded to the data-parallel multiple;
+    yields (batch, true_count) so metrics can exclude the padding."""
+    for batch in ds.batches(batch_size, drop_remainder=False):
+        b = int(batch["label"].shape[0])
+        pad = (-b) % dp
+        if pad:
+            batch = {
+                k: np.concatenate([v, np.repeat(v[-1:], pad, 0)])
+                for k, v in batch.items()
+            }
+        yield batch, b
+
+
+def _eval_dataset(cfg: Config, ctx: SPMDContext) -> InMemoryDataset:
+    files = discover_files(
+        cfg.data.val_data_dir or cfg.data.training_data_dir,
+        patterns=("va", "val", "eval"),
+        shuffle=False,
+    )
+    if not files:
+        raise FileNotFoundError(
+            f"no va*/val*/eval* tfrecords under {cfg.data.val_data_dir!r}"
+        )
+    return InMemoryDataset.from_files(
+        files, cfg.model.field_size,
+        permute_vocab=ctx.true_feature_size if cfg.data.permute_ids else 0,
+    )
+
+
+def run_eval(cfg: Config, ctx: SPMDContext, state: TrainState, log: MetricLogger) -> dict:
+    """EVAL task: streaming AUC + mean loss over the FULL validation set
+    (ps:282, ps:522-525).  Tail batches are padded to the data-parallel
+    multiple with zero-weight rows, so every record counts exactly once."""
+    eval_step = make_spmd_eval_step(ctx)
+    ds = _eval_dataset(cfg, ctx)
+    dp = ctx.mesh.shape["data"]
+    auc_state = new_auc_state()
+    losses, counts = [], 0
+    for batch, true_count in _padded_batches(ds, cfg.data.batch_size, dp):
+        b = batch["label"].shape[0]
+        batch["weight"] = np.concatenate(
+            [np.ones(true_count, np.float32), np.zeros(b - true_count, np.float32)]
+        )
+        sb = shard_batch(ctx, batch)
+        auc_state, m = eval_step(state, auc_state, sb)
+        losses.append(float(m["loss"]) * true_count)
+        counts += true_count
+    result = {
+        "auc": float(auc_value(auc_state)),
+        "loss": (sum(losses) / counts) if counts else float("nan"),
+        "examples": counts,
+    }
+    log.event("eval", **result)
+    return result
+
+
+def run_train(cfg: Config) -> TrainState:
+    """TRAIN task: resume-or-init, epoch loop, periodic ckpt, final eval+export."""
+    ctx = setup(cfg)
+    maybe_clear(cfg.run.model_dir, cfg.run.clear_existing_model)
+    log = MetricLogger(log_steps=cfg.run.log_steps)
+    # checkpoint cadence lives HERE (the step % N gate below) — Checkpointer
+    # itself has no interval policy, so there is exactly one mechanism
+    ckpt = Checkpointer(cfg.run.model_dir, max_to_keep=cfg.run.keep_checkpoints)
+    state = create_spmd_state(ctx)
+    if ckpt.latest_step() is not None:
+        state = ckpt.restore(state)
+        log.event("resume", step=int(state.step))
+    train_step = make_spmd_train_step(ctx)
+
+    profile_cm = (
+        jax.profiler.trace(cfg.run.profile_dir)
+        if cfg.run.profile_dir
+        else contextlib.nullcontext()
+    )
+    with profile_cm, _train_batches(cfg, ctx) as batches:
+        for batch in batches:
+            batch_size = int(batch["label"].shape[0])
+            state, metrics = train_step(state, batch)
+            step = int(state.step)
+            log.step(step, batch_size, {k: v for k, v in metrics.items()
+                                        if k != "loss_per_shard"})
+            if cfg.run.checkpoint_every_steps and step % cfg.run.checkpoint_every_steps == 0:
+                ckpt.save(state)
+
+    ckpt.save(state)
+    if cfg.data.val_data_dir:
+        run_eval(cfg, ctx, state, log)
+    if cfg.run.servable_model_dir:
+        export_servable(cfg, state, cfg.run.servable_model_dir)
+        log.event("export", path=cfg.run.servable_model_dir)
+    ckpt.close()
+    return state
+
+
+def run_infer(cfg: Config, *, output_path: str | None = None) -> str:
+    """INFER task: batch-score te*/test* records to pred.txt (ps:526-533)."""
+    ctx = setup(cfg)
+    ckpt = Checkpointer(cfg.run.model_dir)
+    state = ckpt.restore(create_spmd_state(ctx))
+    predict_step = make_spmd_predict_step(ctx)
+    # fallback chain, not a union: te*/test* first (the reference's infer
+    # globs te* only, ps:526-533); va*/val* only when no test files exist
+    base = cfg.data.test_data_dir or cfg.data.val_data_dir
+    files = discover_files(base, patterns=("te", "test"), shuffle=False)
+    if not files:
+        files = discover_files(base, patterns=("va", "val"), shuffle=False)
+    if not files:
+        raise FileNotFoundError("no te*/test* (or va*/val*) tfrecords to score")
+    ds = InMemoryDataset.from_files(
+        files, cfg.model.field_size,
+        permute_vocab=ctx.true_feature_size if cfg.data.permute_ids else 0,
+    )
+    out = output_path or os.path.join(base, "pred.txt")
+    probs = []
+    for batch, true_count in _padded_batches(ds, cfg.data.batch_size, ctx.mesh.shape["data"]):
+        sb = shard_batch(ctx, batch)
+        p = np.asarray(jax.device_get(predict_step(state, sb)))
+        probs.append(p[:true_count])
+    n = write_predictions(iter(probs), out)
+    ckpt.close()
+    MetricLogger().event("infer", path=out, examples=n)
+    return out
+
+
+def run_export(cfg: Config) -> str:
+    """EXPORT task: restore latest checkpoint -> servable (ps:535-551)."""
+    ctx = setup(cfg)
+    ckpt = Checkpointer(cfg.run.model_dir)
+    state = ckpt.restore(create_spmd_state(ctx))
+    path = export_servable(cfg, state, cfg.run.servable_model_dir)
+    ckpt.close()
+    MetricLogger().event("export", path=path)
+    return path
+
+
+def run_task(cfg: Config):
+    """task_type dispatch (ps:501-551): train | eval | infer | export."""
+    task = cfg.run.task_type
+    if task == "train":
+        return run_train(cfg)
+    if task == "eval":
+        ctx = setup(cfg)
+        ckpt = Checkpointer(cfg.run.model_dir)
+        state = ckpt.restore(create_spmd_state(ctx))
+        result = run_eval(cfg, ctx, state, MetricLogger())
+        ckpt.close()
+        return result
+    if task == "infer":
+        return run_infer(cfg)
+    if task == "export":
+        return run_export(cfg)
+    raise ValueError(f"unknown task_type {task!r} (train|eval|infer|export)")

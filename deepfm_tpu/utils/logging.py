@@ -1,0 +1,73 @@
+"""Structured per-step metrics logging — the observability capability.
+
+The reference's observability is ~25 print()s of cluster state plus the
+Estimator's default loss logging into CloudWatch (SURVEY §5); its
+``log_steps`` flag existed but was never wired (ps:55).  Here ``log_steps``
+is honored: every N steps one structured line with loss, examples/sec and
+step time goes to stdout (and optionally a JSONL file).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import IO, Any, Mapping
+
+
+class MetricLogger:
+    def __init__(
+        self,
+        *,
+        log_steps: int = 100,
+        stream: IO | None = None,
+        jsonl_path: str | None = None,
+        prefix: str = "train",
+    ):
+        self.log_steps = max(1, log_steps)
+        self._stream = stream or sys.stdout
+        self._jsonl = open(jsonl_path, "a") if jsonl_path else None
+        self._prefix = prefix
+        self._t_last = time.perf_counter()
+        self._examples_since = 0
+        self._steps_since = 0
+
+    def step(self, step: int, batch_size: int, metrics: Mapping[str, Any]) -> None:
+        self._examples_since += batch_size
+        self._steps_since += 1
+        if step % self.log_steps:
+            return
+        now = time.perf_counter()
+        dt = max(now - self._t_last, 1e-9)
+        record = {
+            "kind": self._prefix,
+            "step": int(step),
+            "examples_per_sec": round(self._examples_since / dt, 1),
+            "step_ms": round(1000 * dt / self._steps_since, 3),
+        }
+        for k, v in metrics.items():
+            try:
+                record[k] = round(float(v), 6)
+            except (TypeError, ValueError):
+                continue
+        self._emit(record)
+        self._t_last = now
+        self._examples_since = 0
+        self._steps_since = 0
+
+    def event(self, kind: str, **fields: Any) -> None:
+        record: dict[str, Any] = {"kind": kind}
+        for k, v in fields.items():
+            record[k] = float(v) if isinstance(v, (int, float)) else v
+        self._emit(record)
+
+    def _emit(self, record: dict) -> None:
+        line = json.dumps(record)
+        print(line, file=self._stream, flush=True)
+        if self._jsonl:
+            self._jsonl.write(line + "\n")
+            self._jsonl.flush()
+
+    def close(self) -> None:
+        if self._jsonl:
+            self._jsonl.close()

@@ -1,0 +1,171 @@
+"""Input-pipeline tests: glob/shard/batch/decode chain, in-memory cache,
+stream (FIFO) mode, device prefetch."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from deepfm_tpu.core.config import DataConfig
+from deepfm_tpu.data import generate_synthetic_ctr
+from deepfm_tpu.data.pipeline import (
+    DevicePrefetcher,
+    InMemoryDataset,
+    batched_ctr_batches,
+    discover_files,
+    make_input_pipeline,
+    record_stream,
+)
+from deepfm_tpu.data.sharding import ShardDecision, WorkerTopology
+from deepfm_tpu.data.tfrecord import frame_record, read_records
+from deepfm_tpu.data.example_proto import serialize_ctr_example
+
+FIELD = 5
+
+
+def _write(tmp_path, name, n, seed=0):
+    path = tmp_path / name
+    generate_synthetic_ctr(path, num_records=n, feature_size=100, field_size=FIELD, seed=seed)
+    return str(path)
+
+
+def test_discover_files(tmp_path):
+    _write(tmp_path, "tr-001.tfrecords", 5)
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    _write(sub, "train-xyz.tfrecords", 5)
+    _write(tmp_path, "va-001.tfrecords", 5)
+    files = discover_files(str(tmp_path), ("tr", "train"), shuffle=False)
+    assert len(files) == 2
+    assert all("va-" not in f for f in files)
+    # deterministic shuffle with a seed
+    s1 = discover_files(str(tmp_path), ("tr", "train"), shuffle=True, seed=3)
+    s2 = discover_files(str(tmp_path), ("tr", "train"), shuffle=True, seed=3)
+    assert s1 == s2
+
+
+def test_record_stream_sharded(tmp_path):
+    f1 = _write(tmp_path, "tr-a.tfrecords", 10, seed=1)
+    f2 = _write(tmp_path, "tr-b.tfrecords", 10, seed=2)
+    all_recs = list(record_stream([f1, f2]))
+    assert len(all_recs) == 20
+    shard0 = list(record_stream([f1, f2], decision=ShardDecision(4, 0)))
+    shard2 = list(record_stream([f1, f2], decision=ShardDecision(4, 2)))
+    assert len(shard0) == 5 and len(shard2) == 5
+    assert shard0 == all_recs[0::4]
+    assert shard2 == all_recs[2::4]
+
+
+def test_batched_decode_and_drop_remainder(tmp_path):
+    f = _write(tmp_path, "tr.tfrecords", 23)
+    batches = list(
+        batched_ctr_batches(record_stream([f]), batch_size=8, field_size=FIELD)
+    )
+    assert len(batches) == 2  # 23 // 8, remainder dropped
+    assert batches[0]["feat_ids"].shape == (8, FIELD)
+    batches = list(
+        batched_ctr_batches(
+            record_stream([f]), batch_size=8, field_size=FIELD, drop_remainder=False
+        )
+    )
+    assert len(batches) == 3
+    assert batches[-1]["feat_ids"].shape == (7, FIELD)
+
+
+def test_in_memory_dataset_epochs_and_shuffle(tmp_path):
+    f = _write(tmp_path, "tr.tfrecords", 50)
+    ds = InMemoryDataset.from_files([f], FIELD)
+    assert len(ds) == 50
+    b1 = list(ds.batches(16, num_epochs=2))
+    assert len(b1) == 6  # 3 per epoch
+    # shuffle changes order but not content (feat_vals are unique per record;
+    # with field_size=5 all ids are the numeric 1..5, identical every record)
+    b_shuf = list(ds.batches(50, num_epochs=1, shuffle=True, seed=1, drop_remainder=False))
+    assert not np.array_equal(b_shuf[0]["feat_vals"], ds.feat_vals)
+    assert sorted(b_shuf[0]["label"].tolist()) == sorted(ds.label.tolist())
+    np.testing.assert_allclose(
+        np.sort(b_shuf[0]["feat_vals"].ravel()), np.sort(ds.feat_vals.ravel())
+    )
+
+
+def test_make_input_pipeline_file_mode(tmp_path):
+    _write(tmp_path, "tr-0.tfrecords", 16, seed=1)
+    _write(tmp_path, "tr-1.tfrecords", 16, seed=2)
+    cfg = DataConfig(batch_size=8, num_epochs=2, shuffle_files=False)
+    topo = WorkerTopology(1, 0, 1, 0)
+    batches = list(
+        make_input_pipeline(cfg, topo, field_size=FIELD, data_dir=str(tmp_path))
+    )
+    assert len(batches) == 8  # 32 recs / 8 per batch × 2 epochs
+    # two workers partition the records exactly
+    t0 = WorkerTopology(2, 0, 1, 0)
+    t1 = WorkerTopology(2, 1, 1, 0)
+    b0 = list(make_input_pipeline(cfg, t0, field_size=FIELD, data_dir=str(tmp_path), num_epochs=1))
+    b1 = list(make_input_pipeline(cfg, t1, field_size=FIELD, data_dir=str(tmp_path), num_epochs=1))
+    ids0 = np.concatenate([b["feat_ids"] for b in b0])
+    ids1 = np.concatenate([b["feat_ids"] for b in b1])
+    assert ids0.shape[0] + ids1.shape[0] == 32
+
+
+def test_make_input_pipeline_missing_dir(tmp_path):
+    cfg = DataConfig(batch_size=8)
+    with pytest.raises(FileNotFoundError, match="tfrecords"):
+        list(
+            make_input_pipeline(
+                cfg, WorkerTopology(1, 0, 1, 0), field_size=FIELD,
+                data_dir=str(tmp_path / "nope"),
+            )
+        )
+
+
+def test_stream_mode_fifo(tmp_path):
+    """Pipe-mode capability: the pipeline reads a FIFO channel end to end."""
+    fifo = tmp_path / "training"
+    os.mkfifo(fifo)
+    payload = b"".join(
+        frame_record(serialize_ctr_example(1.0, [1, 2, 3, 4, 5], [1.0] * 5))
+        for _ in range(24)
+    )
+
+    def feeder():
+        with open(fifo, "wb") as f:
+            f.write(payload)
+
+    t = threading.Thread(target=feeder, daemon=True)
+    t.start()
+    cfg = DataConfig(batch_size=8, stream_mode=True)
+    batches = list(
+        make_input_pipeline(
+            cfg, WorkerTopology(1, 0, 1, 0), field_size=FIELD, data_dir=str(tmp_path)
+        )
+    )
+    t.join()
+    assert len(batches) == 3
+    assert all(b["feat_ids"].shape == (8, FIELD) for b in batches)
+
+
+def test_permute_ids_in_pipeline(tmp_path):
+    f = _write(tmp_path, "tr.tfrecords", 20)
+    plain = InMemoryDataset.from_files([f], FIELD)
+    permuted = InMemoryDataset.from_files([f], FIELD, permute_vocab=100)
+    assert not np.array_equal(plain.feat_ids, permuted.feat_ids)
+    assert permuted.feat_ids.max() < 100
+    assert permuted.feat_ids.min() >= 0
+    # same multiset of labels/values — only ids are remapped
+    np.testing.assert_array_equal(plain.label, permuted.label)
+
+
+def test_device_prefetcher_order_and_errors():
+    items = iter(range(10))
+    pf = DevicePrefetcher(items, lambda x: x * 2, depth=3)
+    assert list(pf) == [0, 2, 4, 6, 8, 10, 12, 14, 16, 18]
+
+    def boom():
+        yield 1
+        raise RuntimeError("reader died")
+
+    pf = DevicePrefetcher(boom(), lambda x: x, depth=2)
+    assert next(pf) == 1
+    with pytest.raises(RuntimeError, match="reader died"):
+        next(pf)
