@@ -79,7 +79,8 @@ def _float_list(values: Sequence[float]) -> bytes:
 
 
 def _int64_list(values: Sequence[int]) -> bytes:
-    packed = b"".join(encode_varint(v & 0xFFFFFFFFFFFFFFFF) for v in values)
+    # int(v): numpy int64 scalars overflow on the 64-bit mask; plain ints don't
+    packed = b"".join(encode_varint(int(v) & 0xFFFFFFFFFFFFFFFF) for v in values)
     return _len_delimited(1, packed)  # Int64List.value, packed
 
 

@@ -80,22 +80,21 @@ def batched_ctr_batches(
     """batch -> vectorized decode -> feature dict (ps:158-161 ordering)."""
     from ..parallel.embedding import permute_ids
 
-    buf: list[bytes] = []
-    for rec in records:
-        buf.append(rec)
-        if len(buf) == batch_size:
-            feats, labels = decode_ctr_batch(buf, field_size)
-            ids = feats["feat_ids"]
-            if permute_vocab:
-                ids = permute_ids(ids, permute_vocab, True)
-            yield {"feat_ids": ids, "feat_vals": feats["feat_vals"], "label": labels}
-            buf = []
-    if buf and not drop_remainder:
+    def emit(buf: list[bytes]) -> dict:
         feats, labels = decode_ctr_batch(buf, field_size)
         ids = feats["feat_ids"]
         if permute_vocab:
             ids = permute_ids(ids, permute_vocab, True)
-        yield {"feat_ids": ids, "feat_vals": feats["feat_vals"], "label": labels}
+        return {"feat_ids": ids, "feat_vals": feats["feat_vals"], "label": labels}
+
+    buf: list[bytes] = []
+    for rec in records:
+        buf.append(rec)
+        if len(buf) == batch_size:
+            yield emit(buf)
+            buf = []
+    if buf and not drop_remainder:
+        yield emit(buf)
 
 
 class InMemoryDataset:
@@ -268,6 +267,9 @@ class DevicePrefetcher:
     def __next__(self):
         item = self._q.get()
         if item is self._DONE:
+            # keep the sentinel in the queue: next() after exhaustion must
+            # re-raise StopIteration, not block on an empty queue forever
+            self._q.put(item)
             if self._err is not None:
                 raise self._err
             raise StopIteration

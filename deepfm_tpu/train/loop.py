@@ -149,11 +149,14 @@ def run_train(cfg: Config) -> TrainState:
         if cfg.run.profile_dir
         else contextlib.nullcontext()
     )
+    # host-side step counter: int(state.step) every iteration would block on
+    # the just-dispatched step and defeat async-dispatch pipelining
+    step = int(state.step)
     with profile_cm, _train_batches(cfg, ctx) as batches:
         for batch in batches:
             batch_size = int(batch["label"].shape[0])
             state, metrics = train_step(state, batch)
-            step = int(state.step)
+            step += 1
             log.step(step, batch_size, {k: v for k, v in metrics.items()
                                         if k != "loss_per_shard"})
             if cfg.run.checkpoint_every_steps and step % cfg.run.checkpoint_every_steps == 0:
