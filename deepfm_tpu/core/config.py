@@ -51,6 +51,16 @@ class ModelConfig:
     # xDeepFM CIN layer sizes / DCN-v2 cross depth (ignored by plain deepfm)
     cin_layers: tuple[int, ...] = (128, 128)
     cross_layers: int = 3
+    # two-tower retrieval (model_name="two_tower"; ignored by CTR families):
+    # separate user/item vocabularies and field counts, tower MLP widths,
+    # output dim, and softmax temperature for in-batch negatives
+    user_vocab_size: int = 0          # 0 -> feature_size
+    item_vocab_size: int = 0          # 0 -> feature_size
+    user_field_size: int = 1
+    item_field_size: int = 1
+    tower_layers: tuple[int, ...] = (64, 32)
+    tower_dim: int = 16
+    temperature: float = 0.05
     # compute dtype for the MLP/FM math (params stay f32; bf16 feeds the MXU)
     compute_dtype: str = "bfloat16"
 
@@ -58,6 +68,7 @@ class ModelConfig:
         object.__setattr__(self, "deep_layers", _parse_int_list(self.deep_layers))
         object.__setattr__(self, "dropout_keep", _parse_float_list(self.dropout_keep))
         object.__setattr__(self, "cin_layers", _parse_int_list(self.cin_layers))
+        object.__setattr__(self, "tower_layers", _parse_int_list(self.tower_layers))
         if len(self.dropout_keep) < len(self.deep_layers):
             raise ValueError(
                 f"dropout_keep has {len(self.dropout_keep)} entries for "

@@ -42,7 +42,7 @@ from .mesh import DATA_AXIS, MODEL_AXIS, mesh_shape
 
 # params keys treated as row-sharded embedding tables (must match the model
 # families' table naming and ModelDef.l2_penalty conventions)
-TABLE_KEYS = ("fm_w", "fm_v", "embedding")
+TABLE_KEYS = ("fm_w", "fm_v", "embedding", "user_embedding", "item_embedding")
 
 
 class SPMDContext(NamedTuple):

@@ -213,8 +213,146 @@ def run_export(cfg: Config) -> str:
     return path
 
 
+def _retrieval_setup(cfg: Config):
+    from ..parallel.retrieval import make_retrieval_context
+
+    initialize_distributed(cfg.mesh)
+    mesh = build_mesh(cfg.mesh)
+    return make_retrieval_context(cfg, mesh)
+
+
+def _retrieval_batches(cfg: Config, ctx, data_dir: str, *, num_epochs: int,
+                       shuffle: bool):
+    from ..data.ratings import RatingsDataset
+
+    ds = RatingsDataset.from_path(data_dir)
+    max_u, max_i = ds.max_ids()
+    if max_u >= ctx.true_user_vocab or max_i >= ctx.true_item_vocab:
+        raise ValueError(
+            f"ratings ids exceed configured vocabs: max user {max_u} vs "
+            f"user_vocab_size {ctx.true_user_vocab}, max item {max_i} vs "
+            f"item_vocab_size {ctx.true_item_vocab} — set model.user_vocab_size/"
+            f"model.item_vocab_size"
+        )
+    return ds.batches(
+        cfg.data.batch_size, num_epochs=num_epochs, shuffle=shuffle,
+        seed=cfg.run.seed,
+    )
+
+
+def run_retrieval_train(cfg: Config) -> TrainState:
+    """TRAIN for the two-tower family: ratings file(s) in, in-batch-softmax
+    SPMD steps, periodic ckpt, final retrieval eval + servable export."""
+    from ..parallel.retrieval import (
+        create_retrieval_spmd_state,
+        make_retrieval_spmd_train_step,
+        shard_retrieval_batch,
+    )
+
+    ctx = _retrieval_setup(cfg)
+    maybe_clear(cfg.run.model_dir, cfg.run.clear_existing_model)
+    log = MetricLogger(log_steps=cfg.run.log_steps)
+    ckpt = Checkpointer(cfg.run.model_dir, max_to_keep=cfg.run.keep_checkpoints)
+    state = create_retrieval_spmd_state(ctx)
+    if ckpt.latest_step() is not None:
+        state = ckpt.restore(state)
+        log.event("resume", step=int(state.step))
+    train_step = make_retrieval_spmd_train_step(ctx)
+
+    batches = _retrieval_batches(
+        cfg, ctx, cfg.data.training_data_dir,
+        num_epochs=cfg.data.num_epochs, shuffle=True,
+    )
+    step = int(state.step)
+    with DevicePrefetcher(
+        batches, lambda b: shard_retrieval_batch(ctx, b),
+        depth=cfg.data.prefetch_batches,
+    ) as prefetched:
+        for batch in prefetched:
+            batch_size = int(batch["user_ids"].shape[0])
+            state, metrics = train_step(state, batch)
+            step += 1
+            log.step(step, batch_size, metrics)
+            if cfg.run.checkpoint_every_steps and step % cfg.run.checkpoint_every_steps == 0:
+                ckpt.save(state)
+
+    ckpt.save(state)
+    if cfg.data.val_data_dir:
+        run_retrieval_eval(cfg, ctx, state, log)
+    if cfg.run.servable_model_dir:
+        export_servable(cfg, state, cfg.run.servable_model_dir)
+        log.event("export", path=cfg.run.servable_model_dir)
+    ckpt.close()
+    return state
+
+
+def run_retrieval_eval(cfg: Config, ctx, state: TrainState, log: MetricLogger) -> dict:
+    """EVAL for two-tower: mean in-batch-softmax loss + top1/recall@10 over
+    full batches of the validation ratings (remainder dropped: in-batch
+    metrics need a constant candidate-pool size to be comparable)."""
+    from ..parallel.retrieval import (
+        make_retrieval_spmd_eval_step,
+        shard_retrieval_batch,
+    )
+
+    eval_step = make_retrieval_spmd_eval_step(ctx)
+    sums: dict[str, float] = {}
+    batches = 0
+    for batch in _retrieval_batches(
+        cfg, ctx, cfg.data.val_data_dir, num_epochs=1, shuffle=False,
+    ):
+        m = eval_step(state, shard_retrieval_batch(ctx, batch))
+        batches += 1
+        for k, v in m.items():
+            sums[k] = sums.get(k, 0.0) + float(v)
+    if not batches:
+        raise ValueError(
+            f"validation ratings under {cfg.data.val_data_dir!r} have fewer "
+            f"rows than one batch ({cfg.data.batch_size}) — nothing to eval"
+        )
+    result = {
+        "loss": sums["loss"] / batches,
+        "top1_acc": sums["top1_acc"] / batches,
+        "recall_at_10": sums["recall_at_10"] / batches,
+        "examples": sums["count"],
+    }
+    log.event("eval", **result)
+    return result
+
+
+def run_retrieval_task(cfg: Config):
+    """Two-tower task dispatch: train | eval | export (infer has no meaning
+    without a candidate corpus to rank — use eval, or load the servable and
+    encode corpora with models.two_tower.apply_two_tower)."""
+    from ..parallel.retrieval import create_retrieval_spmd_state
+
+    task = cfg.run.task_type
+    if task == "train":
+        return run_retrieval_train(cfg)
+    if task == "eval":
+        ctx = _retrieval_setup(cfg)
+        ckpt = Checkpointer(cfg.run.model_dir)
+        state = ckpt.restore(create_retrieval_spmd_state(ctx))
+        result = run_retrieval_eval(cfg, ctx, state, MetricLogger())
+        ckpt.close()
+        return result
+    if task == "export":
+        ctx = _retrieval_setup(cfg)
+        ckpt = Checkpointer(cfg.run.model_dir)
+        state = ckpt.restore(create_retrieval_spmd_state(ctx))
+        path = export_servable(cfg, state, cfg.run.servable_model_dir)
+        ckpt.close()
+        MetricLogger().event("export", path=path)
+        return path
+    raise ValueError(
+        f"task_type {task!r} unsupported for two_tower (train|eval|export)"
+    )
+
+
 def run_task(cfg: Config):
     """task_type dispatch (ps:501-551): train | eval | infer | export."""
+    if cfg.model.model_name == "two_tower":
+        return run_retrieval_task(cfg)
     task = cfg.run.task_type
     if task == "train":
         return run_train(cfg)

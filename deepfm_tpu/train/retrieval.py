@@ -1,0 +1,90 @@
+"""Dense (single-chip) train/eval steps for the two-tower retrieval family.
+
+Same TrainState / optimizer plumbing as the CTR steps (train/step.py); the
+loss couples examples across the batch (in-batch softmax), so this family
+gets its own step builders instead of ModelDef dispatch.  The sharded
+counterpart with the cross-chip all-gather lives in parallel/retrieval.py.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..core.config import Config
+from ..models.two_tower import (
+    apply_two_tower,
+    in_batch_softmax_loss,
+    init_two_tower,
+    retrieval_metrics,
+    two_tower_l2_penalty,
+)
+from .optimizer import build_optimizer
+from .step import TrainState, _dp_size
+
+
+def create_retrieval_state(cfg: Config, key: jax.Array | None = None) -> TrainState:
+    key = jax.random.PRNGKey(cfg.run.seed) if key is None else key
+    init_key, step_key = jax.random.split(key)
+    params, model_state = init_two_tower(init_key, cfg.model)
+    tx = build_optimizer(cfg.optimizer, data_parallel_size=_dp_size(cfg))
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        model_state=model_state,
+        opt_state=tx.init(params),
+        rng=step_key,
+    )
+
+
+def retrieval_loss(cfg: Config, params, batch) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Full-batch in-batch-softmax loss: positives on the diagonal."""
+    towers = apply_two_tower(params, batch, cfg=cfg.model)
+    b = towers.user.shape[0]
+    labels = jnp.arange(b)
+    ce, scores = in_batch_softmax_loss(
+        towers.user, towers.item, labels, temperature=cfg.model.temperature
+    )
+    loss = jnp.mean(ce) + two_tower_l2_penalty(params, cfg.model.l2_reg)
+    return loss, scores
+
+
+def make_retrieval_train_step(cfg: Config) -> Callable:
+    tx = build_optimizer(cfg.optimizer, data_parallel_size=_dp_size(cfg))
+
+    def train_step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
+        def loss_fn(params):
+            return retrieval_loss(cfg, params, batch)
+
+        (loss, scores), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss}
+        metrics.update(retrieval_metrics(scores, jnp.arange(scores.shape[0])))
+        return (
+            TrainState(
+                step=state.step + 1,
+                params=new_params,
+                model_state=state.model_state,
+                opt_state=new_opt_state,
+                rng=state.rng,
+            ),
+            metrics,
+        )
+
+    return train_step
+
+
+def make_retrieval_eval_step(cfg: Config) -> Callable:
+    def eval_step(state: TrainState, batch: dict) -> dict:
+        loss, scores = retrieval_loss(cfg, state.params, batch)
+        metrics = {"loss": loss, "count": jnp.asarray(scores.shape[0])}
+        metrics.update(retrieval_metrics(scores, jnp.arange(scores.shape[0])))
+        return metrics
+
+    return eval_step
