@@ -97,6 +97,58 @@ def batched_ctr_batches(
         yield emit(buf)
 
 
+def ctr_batches_from_sources(
+    sources: Iterable[str | os.PathLike],
+    *,
+    batch_size: int,
+    field_size: int,
+    decision: ShardDecision | None = None,
+    drop_remainder: bool = True,
+    permute_vocab: int = 0,
+    verify_crc: bool | None = None,
+) -> Iterator[dict]:
+    """Source files/FIFOs -> decoded batches, via the C++ reader when built.
+
+    The native path (deepfm_tpu/native) fuses framing + CRC + record-level
+    sharding + Example decode and hands back whole numpy batches; the
+    pure-Python chain (record_stream -> batched_ctr_batches) is the portable
+    fallback with identical semantics (tests assert parity).
+
+    ``verify_crc=None`` means "verify when it's cheap": the native reader
+    checks (hardware crc32c is ~free), the Python fallback skips (software
+    CRC would dominate decode time).  Pass an explicit bool to force either.
+    """
+    sources = [os.fspath(s) for s in sources]
+    shard_n = decision.num_shards if decision else 1
+    shard_i = decision.shard_index if decision else 0
+    from .. import native
+
+    if native.available():
+        from ..parallel.embedding import permute_ids
+
+        reader = native.NativeCtrReader(
+            sources,
+            batch_size=batch_size,
+            field_size=field_size,
+            shard_n=shard_n,
+            shard_i=shard_i,
+            drop_remainder=drop_remainder,
+            verify=True if verify_crc is None else verify_crc,
+        )
+        for b in reader:
+            if permute_vocab:
+                b["feat_ids"] = permute_ids(b["feat_ids"], permute_vocab, True)
+            yield b
+        return
+    yield from batched_ctr_batches(
+        record_stream(sources, decision=decision, verify_crc=bool(verify_crc)),
+        batch_size=batch_size,
+        field_size=field_size,
+        drop_remainder=drop_remainder,
+        permute_vocab=permute_vocab,
+    )
+
+
 class InMemoryDataset:
     """Decode-once cache: the whole dataset as contiguous arrays.
 
@@ -117,10 +169,11 @@ class InMemoryDataset:
         *, decision: ShardDecision | None = None, permute_vocab: int = 0,
     ) -> "InMemoryDataset":
         batches = list(
-            batched_ctr_batches(
-                record_stream(files, decision=decision),
+            ctr_batches_from_sources(
+                files,
                 batch_size=8192,
                 field_size=field_size,
+                decision=decision,
                 drop_remainder=False,
                 permute_vocab=permute_vocab,
             )
@@ -186,12 +239,11 @@ def make_input_pipeline(
         # worker, mirroring the reference's channel naming, hvd nb cell 8)
         suffix = f"-{decision.channel_index}" if decision.channel_index else ""
         fifo = os.path.join(base_dir, f"{channel}{suffix}")
-        sources: Iterable[str] = [fifo]
-        records = record_stream(sources, decision=decision)
-        yield from batched_ctr_batches(
-            records,
+        yield from ctr_batches_from_sources(
+            [fifo],
             batch_size=cfg.batch_size,
             field_size=field_size,
+            decision=decision,
             drop_remainder=cfg.drop_remainder,
             permute_vocab=permute_vocab,
         )
@@ -206,11 +258,11 @@ def make_input_pipeline(
             f"no {tuple(cfg.file_patterns)}*.tfrecords under {base_dir!r}"
         )
     for _ in range(max(1, epochs)):
-        records = record_stream(files, decision=decision)
-        yield from batched_ctr_batches(
-            records,
+        yield from ctr_batches_from_sources(
+            files,
             batch_size=cfg.batch_size,
             field_size=field_size,
+            decision=decision,
             drop_remainder=cfg.drop_remainder,
             permute_vocab=permute_vocab,
         )

@@ -36,9 +36,10 @@ def parse_ratings_line(line: str) -> tuple[int, int, float] | None:
     try:
         user = int(parts[0])
         item = int(parts[1])
+        # trailing separator leaves an empty parts[2]; treat as implicit 1.0
+        rating = float(parts[2]) if len(parts) > 2 and parts[2].strip() else 1.0
     except ValueError:
-        return None  # header row like "userId,movieId,rating"
-    rating = float(parts[2]) if len(parts) > 2 else 1.0
+        return None  # header row like "userId,movieId,rating", or junk rating
     return user, item, rating
 
 
@@ -110,6 +111,12 @@ class RatingsDataset:
         if len(self) == 0:
             return -1, -1
         return int(self.user_ids.max()), int(self.item_ids.max())
+
+    def min_ids(self) -> tuple[int, int]:
+        """(min user id, min item id) — negative ids are data corruption."""
+        if len(self) == 0:
+            return 0, 0
+        return int(self.user_ids.min()), int(self.item_ids.min())
 
     def batches(
         self,

@@ -101,6 +101,29 @@ def init_two_tower(key: jax.Array, cfg: ModelConfig) -> tuple[dict, dict]:
     return params, {}
 
 
+def encode_tower(
+    params: dict,
+    ids: jnp.ndarray,
+    vals: jnp.ndarray,
+    *,
+    cfg: ModelConfig,
+    side: str,
+    lookup_fn=dense_lookup,
+) -> jnp.ndarray:
+    """Encode one side (``side`` in {"user", "item"}): lookup -> scale ->
+    tower MLP -> L2-normalized [B, D].  The serving-time entry point for
+    encoding query users or corpus items independently."""
+    field = cfg.user_field_size if side == "user" else cfg.item_field_size
+    ids = ids.reshape(-1, field)
+    vals = vals.reshape(-1, field).astype(jnp.float32)
+    emb = lookup_fn(params[f"{side}_embedding"], ids) * vals[..., None]
+    return _apply_tower(
+        params[f"{side}_tower"],
+        emb.reshape(emb.shape[0], field * cfg.embedding_size),
+        cfg,
+    )
+
+
 def apply_two_tower(
     params: dict,
     batch: dict,
@@ -113,26 +136,13 @@ def apply_two_tower(
     """Encode the batch's users and items.  ``user_lookup_fn``/
     ``item_lookup_fn`` override ``lookup_fn`` per table (the sharded path
     passes per-table lookups since the two vocabs shard independently)."""
-    u_lookup = user_lookup_fn or lookup_fn
-    i_lookup = item_lookup_fn or lookup_fn
-
-    uids = batch["user_ids"].reshape(-1, cfg.user_field_size)
-    iids = batch["item_ids"].reshape(-1, cfg.item_field_size)
-    uvals = batch["user_vals"].reshape(-1, cfg.user_field_size).astype(jnp.float32)
-    ivals = batch["item_vals"].reshape(-1, cfg.item_field_size).astype(jnp.float32)
-
-    u_emb = u_lookup(params["user_embedding"], uids) * uvals[..., None]
-    i_emb = i_lookup(params["item_embedding"], iids) * ivals[..., None]
-
-    u = _apply_tower(
-        params["user_tower"],
-        u_emb.reshape(u_emb.shape[0], cfg.user_field_size * cfg.embedding_size),
-        cfg,
+    u = encode_tower(
+        params, batch["user_ids"], batch["user_vals"],
+        cfg=cfg, side="user", lookup_fn=user_lookup_fn or lookup_fn,
     )
-    i = _apply_tower(
-        params["item_tower"],
-        i_emb.reshape(i_emb.shape[0], cfg.item_field_size * cfg.embedding_size),
-        cfg,
+    i = encode_tower(
+        params, batch["item_ids"], batch["item_vals"],
+        cfg=cfg, side="item", lookup_fn=item_lookup_fn or lookup_fn,
     )
     return TowerOutputs(user=u, item=i)
 

@@ -1,1 +1,6 @@
-from .export import export_servable, load_servable, write_predictions  # noqa: F401
+from .export import (  # noqa: F401
+    export_servable,
+    load_retrieval_servable,
+    load_servable,
+    write_predictions,
+)

@@ -53,21 +53,15 @@ def export_servable(
     return directory
 
 
-def load_servable(directory: str | os.PathLike) -> tuple[Callable, Config]:
-    """Load a servable and return (jitted predict fn, config).
-
-    predict(feat_ids [B, F] int, feat_vals [B, F] f32) -> prob [B] f32 —
-    the reference's serving signature (ps:538-547).
-    """
-    directory = os.path.abspath(directory)
+def _load_config(directory: str) -> Config:
     with open(os.path.join(directory, "config.json")) as f:
-        cfg = Config.from_dict(json.load(f))
-    model = get_model(cfg.model)
-    # restore against the abstract structure implied by the config — shape-
-    # safe (and silences orbax's no-target warning)
-    abstract_params, abstract_state = jax.eval_shape(
-        lambda: model.init(jax.random.PRNGKey(0), cfg.model)
-    )
+        return Config.from_dict(json.load(f))
+
+
+def _restore_payload(directory: str, init_fn: Callable) -> tuple[dict, dict]:
+    """Restore (params, model_state) against the abstract structure implied
+    by the config — shape-safe (and silences orbax's no-target warning)."""
+    abstract_params, abstract_state = jax.eval_shape(init_fn)
     device = jax.sharding.SingleDeviceSharding(jax.devices()[0])
     abstract_params, abstract_state = jax.tree_util.tree_map(
         lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=device),
@@ -79,7 +73,26 @@ def load_servable(directory: str | os.PathLike) -> tuple[Callable, Config]:
         {"params": abstract_params, "model_state": abstract_state},
     )
     ckptr.close()
-    params, model_state = payload["params"], payload["model_state"]
+    return payload["params"], payload["model_state"]
+
+
+def load_servable(directory: str | os.PathLike) -> tuple[Callable, Config]:
+    """Load a CTR servable and return (jitted predict fn, config).
+
+    predict(feat_ids [B, F] int, feat_vals [B, F] f32) -> prob [B] f32 —
+    the reference's serving signature (ps:538-547).
+    """
+    directory = os.path.abspath(directory)
+    cfg = _load_config(directory)
+    if cfg.model.model_name == "two_tower":
+        raise ValueError(
+            "this servable is a two-tower retrieval model; "
+            "use serve.load_retrieval_servable"
+        )
+    model = get_model(cfg.model)
+    params, model_state = _restore_payload(
+        directory, lambda: model.init(jax.random.PRNGKey(0), cfg.model)
+    )
 
     @jax.jit
     def predict(feat_ids, feat_vals):
@@ -89,6 +102,42 @@ def load_servable(directory: str | os.PathLike) -> tuple[Callable, Config]:
         return jax.nn.sigmoid(logits)
 
     return predict, cfg
+
+
+def load_retrieval_servable(
+    directory: str | os.PathLike,
+) -> tuple[Callable, Callable, Config]:
+    """Load a two-tower servable: (encode_user, encode_item, config).
+
+    ``encode_user(user_ids [B,Fu] int, user_vals [B,Fu] f32) -> [B,D] f32``
+    and symmetrically for items — the dual-encoder serving signature (query
+    encoding online, corpus encoding offline for ANN indexing).
+    """
+    from ..models.two_tower import encode_tower, init_two_tower
+
+    directory = os.path.abspath(directory)
+    cfg = _load_config(directory)
+    if cfg.model.model_name != "two_tower":
+        raise ValueError(
+            f"servable holds model {cfg.model.model_name!r}; use load_servable"
+        )
+    params, _ = _restore_payload(
+        directory, lambda: init_two_tower(jax.random.PRNGKey(0), cfg.model)
+    )
+
+    @jax.jit
+    def encode_user(user_ids, user_vals):
+        return encode_tower(
+            params, user_ids, user_vals, cfg=cfg.model, side="user"
+        )
+
+    @jax.jit
+    def encode_item(item_ids, item_vals):
+        return encode_tower(
+            params, item_ids, item_vals, cfg=cfg.model, side="item"
+        )
+
+    return encode_user, encode_item, cfg
 
 
 def write_predictions(

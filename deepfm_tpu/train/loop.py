@@ -166,7 +166,9 @@ def run_train(cfg: Config) -> TrainState:
     if cfg.data.val_data_dir:
         run_eval(cfg, ctx, state, log)
     if cfg.run.servable_model_dir:
-        export_servable(cfg, state, cfg.run.servable_model_dir)
+        # ctx.cfg, not cfg: the servable config must record the mesh-PADDED
+        # vocab so load_servable's restore target matches the saved shapes
+        export_servable(ctx.cfg, state, cfg.run.servable_model_dir)
         log.event("export", path=cfg.run.servable_model_dir)
     ckpt.close()
     return state
@@ -207,7 +209,7 @@ def run_export(cfg: Config) -> str:
     ctx = setup(cfg)
     ckpt = Checkpointer(cfg.run.model_dir)
     state = ckpt.restore(create_spmd_state(ctx))
-    path = export_servable(cfg, state, cfg.run.servable_model_dir)
+    path = export_servable(ctx.cfg, state, cfg.run.servable_model_dir)
     ckpt.close()
     MetricLogger().event("export", path=path)
     return path
@@ -233,6 +235,14 @@ def _retrieval_batches(cfg: Config, ctx, data_dir: str, *, num_epochs: int,
             f"user_vocab_size {ctx.true_user_vocab}, max item {max_i} vs "
             f"item_vocab_size {ctx.true_item_vocab} — set model.user_vocab_size/"
             f"model.item_vocab_size"
+        )
+    min_u, min_i = ds.min_ids()
+    if min_u < 0 or min_i < 0:
+        # full range check here is what lets the hot loop pass
+        # validate_ids=False: without it a negative id would silently train
+        # on a masked-to-zero embedding row
+        raise ValueError(
+            f"ratings contain negative ids (min user {min_u}, min item {min_i})"
         )
     return ds.batches(
         cfg.data.batch_size, num_epochs=num_epochs, shuffle=shuffle,
@@ -265,7 +275,9 @@ def run_retrieval_train(cfg: Config) -> TrainState:
     )
     step = int(state.step)
     with DevicePrefetcher(
-        batches, lambda b: shard_retrieval_batch(ctx, b),
+        # validate_ids=False: _retrieval_batches already range-checked the
+        # whole dataset against both vocabs
+        batches, lambda b: shard_retrieval_batch(ctx, b, validate_ids=False),
         depth=cfg.data.prefetch_batches,
     ) as prefetched:
         for batch in prefetched:
@@ -280,7 +292,7 @@ def run_retrieval_train(cfg: Config) -> TrainState:
     if cfg.data.val_data_dir:
         run_retrieval_eval(cfg, ctx, state, log)
     if cfg.run.servable_model_dir:
-        export_servable(cfg, state, cfg.run.servable_model_dir)
+        export_servable(ctx.cfg, state, cfg.run.servable_model_dir)
         log.event("export", path=cfg.run.servable_model_dir)
     ckpt.close()
     return state
@@ -340,7 +352,7 @@ def run_retrieval_task(cfg: Config):
         ctx = _retrieval_setup(cfg)
         ckpt = Checkpointer(cfg.run.model_dir)
         state = ckpt.restore(create_retrieval_spmd_state(ctx))
-        path = export_servable(cfg, state, cfg.run.servable_model_dir)
+        path = export_servable(ctx.cfg, state, cfg.run.servable_model_dir)
         ckpt.close()
         MetricLogger().event("export", path=path)
         return path

@@ -4,6 +4,7 @@ recovery, serving capabilities)."""
 import os
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -144,6 +145,101 @@ def test_export_and_load_servable(tmp_path):
 
     direct = np.asarray(jax.jit(make_predict_step(CFG))(state, batch))
     np.testing.assert_allclose(probs, direct, rtol=1e-6)
+
+
+def test_export_and_load_retrieval_servable(tmp_path):
+    from deepfm_tpu.models.two_tower import apply_two_tower, init_two_tower
+    from deepfm_tpu.serve import load_retrieval_servable
+    from deepfm_tpu.train.step import TrainState
+
+    rcfg = CFG.with_overrides(
+        model={
+            "model_name": "two_tower",
+            "user_vocab_size": 50,
+            "item_vocab_size": 40,
+            "user_field_size": 2,
+            "item_field_size": 3,
+            "tower_layers": (8,),
+            "tower_dim": 4,
+        }
+    )
+    params, mstate = init_two_tower(jax.random.PRNGKey(0), rcfg.model)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32), params=params, model_state=mstate,
+        opt_state=(), rng=jax.random.PRNGKey(0),
+    )
+    out = export_servable(rcfg, state, tmp_path / "servable")
+
+    # the CTR loader must refuse with a pointer to the retrieval loader
+    with pytest.raises(ValueError, match="load_retrieval_servable"):
+        load_servable(out)
+
+    encode_user, encode_item, cfg2 = load_retrieval_servable(out)
+    uids = np.array([[1, 2], [3, 4]], np.int64)
+    uvals = np.ones((2, 2), np.float32)
+    iids = np.array([[1, 2, 3], [4, 5, 6]], np.int64)
+    ivals = np.ones((2, 3), np.float32)
+    u = np.asarray(encode_user(uids, uvals))
+    i = np.asarray(encode_item(iids, ivals))
+    assert u.shape == (2, 4) and i.shape == (2, 4)
+    np.testing.assert_allclose(np.linalg.norm(u, axis=-1), 1.0, rtol=1e-5)
+
+    # parity with the in-process dual-encoder forward
+    towers = apply_two_tower(
+        params,
+        {"user_ids": uids, "user_vals": uvals,
+         "item_ids": iids, "item_vals": ivals},
+        cfg=rcfg.model,
+    )
+    np.testing.assert_allclose(u, np.asarray(towers.user), rtol=1e-5)
+    np.testing.assert_allclose(i, np.asarray(towers.item), rtol=1e-5)
+
+
+def test_export_padded_vocab_roundtrip(tmp_path):
+    """Exporting a mesh-sharded model whose vocab was PADDED for the mesh
+    must produce a loadable servable (regression: the unpadded config used
+    to be written, making the Orbax restore target mismatch the arrays)."""
+    cfg = CFG.with_overrides(
+        model={"feature_size": 203},  # not divisible by model_parallel=4
+        mesh={"data_parallel": 2, "model_parallel": 4},
+    )
+    mesh = build_mesh(cfg.mesh)
+    ctx = make_context(cfg, mesh)
+    assert ctx.cfg.model.feature_size == 204  # padded
+    state = create_spmd_state(ctx)
+    out = export_servable(ctx.cfg, state, tmp_path / "servable")
+    predict, cfg2 = load_servable(out)
+    assert cfg2.model.feature_size == 204
+    ids = np.array([[0, 1, 2, 3, 202]], np.int64)  # true-vocab ids only
+    probs = np.asarray(predict(ids, np.ones((1, 5), np.float32)))
+    assert probs.shape == (1,) and np.isfinite(probs).all()
+
+    # retrieval family, same padding contract
+    from deepfm_tpu.parallel.retrieval import (
+        create_retrieval_spmd_state,
+        make_retrieval_context,
+    )
+    from deepfm_tpu.serve import load_retrieval_servable
+
+    rcfg = cfg.with_overrides(
+        model={
+            "model_name": "two_tower",
+            "user_vocab_size": 203,
+            "item_vocab_size": 101,
+            "user_field_size": 1,
+            "item_field_size": 1,
+            "tower_layers": (8,),
+            "tower_dim": 4,
+        }
+    )
+    rctx = make_retrieval_context(rcfg, mesh)
+    assert rctx.cfg.model.user_vocab_size == 204
+    rstate = create_retrieval_spmd_state(rctx)
+    rout = export_servable(rctx.cfg, rstate, tmp_path / "rservable")
+    encode_user, encode_item, _ = load_retrieval_servable(rout)
+    u = np.asarray(encode_user(np.array([[202]], np.int64),
+                               np.ones((1, 1), np.float32)))
+    assert u.shape == (1, 4) and np.isfinite(u).all()
 
 
 def test_write_predictions(tmp_path):

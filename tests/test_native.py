@@ -1,0 +1,202 @@
+"""Native (C++) reader: build, parity vs the pure-Python data plane,
+streaming (FIFO), sharding, and corruption detection.
+
+The Python implementations in deepfm_tpu.data are the semantic reference;
+every test here asserts the native path is bit-identical to them.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from deepfm_tpu import native
+from deepfm_tpu.data.example_proto import decode_ctr_batch, serialize_ctr_example
+from deepfm_tpu.data.pipeline import ctr_batches_from_sources
+from deepfm_tpu.data.sharding import ShardDecision
+from deepfm_tpu.data.tfrecord import (
+    frame_record,
+    masked_crc32c,
+    read_records,
+    write_records,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable (no g++?)"
+)
+
+FIELD = 7
+
+
+def _make_records(n, seed=0, field=FIELD):
+    rng = np.random.default_rng(seed)
+    recs = []
+    for i in range(n):
+        recs.append(
+            serialize_ctr_example(
+                float(rng.random()),
+                rng.integers(0, 1000, size=field).tolist(),
+                rng.random(field).astype(np.float32).tolist(),
+            )
+        )
+    return recs
+
+
+def _write(tmp_path, name, recs):
+    p = tmp_path / name
+    write_records(p, recs)
+    return str(p)
+
+
+def test_crc32c_matches_python():
+    for data in [b"", b"a", b"hello world", os.urandom(1 << 16)]:
+        assert native.masked_crc32c(data) == masked_crc32c(data)
+
+
+def test_raw_records_parity(tmp_path):
+    recs = _make_records(257)
+    p = _write(tmp_path, "a.tfrecords", recs)
+    got = list(native.read_records(p))
+    assert got == list(read_records(p))
+    assert got == recs
+
+
+def test_multifile_and_sharding(tmp_path):
+    recs = _make_records(100, seed=1)
+    p1 = _write(tmp_path, "a.tfrecords", recs[:37])
+    p2 = _write(tmp_path, "b.tfrecords", recs[37:])
+    # whole stream preserves file order
+    assert list(native.read_records([p1, p2])) == recs
+    # round-robin shard across the flattened stream: record i -> shard i % n
+    for n in (2, 3):
+        parts = [list(native.read_records([p1, p2], shard_n=n, shard_i=i))
+                 for i in range(n)]
+        for i, part in enumerate(parts):
+            assert part == recs[i::n]
+
+
+def test_ctr_batch_decode_parity(tmp_path):
+    recs = _make_records(50, seed=2)
+    p = _write(tmp_path, "a.tfrecords", recs)
+    reader = native.NativeCtrReader(
+        [p], batch_size=16, field_size=FIELD, drop_remainder=False
+    )
+    batches = list(reader)
+    assert [len(b["label"]) for b in batches] == [16, 16, 16, 2]
+    feats, labels = decode_ctr_batch(recs, FIELD)
+    np.testing.assert_array_equal(
+        np.concatenate([b["feat_ids"] for b in batches]), feats["feat_ids"]
+    )
+    np.testing.assert_array_equal(
+        np.concatenate([b["feat_vals"] for b in batches]), feats["feat_vals"]
+    )
+    np.testing.assert_array_equal(
+        np.concatenate([b["label"] for b in batches]), labels
+    )
+
+
+def test_drop_remainder(tmp_path):
+    p = _write(tmp_path, "a.tfrecords", _make_records(50, seed=3))
+    batches = list(
+        native.NativeCtrReader([p], batch_size=16, field_size=FIELD)
+    )
+    assert [len(b["label"]) for b in batches] == [16, 16, 16]
+
+
+def test_pipeline_dispatch_matches_python_fallback(tmp_path):
+    """ctr_batches_from_sources: native on/off must be bit-identical."""
+    recs = _make_records(64, seed=4)
+    p1 = _write(tmp_path, "a.tfrecords", recs[:30])
+    p2 = _write(tmp_path, "b.tfrecords", recs[30:])
+    kw = dict(
+        batch_size=10,
+        field_size=FIELD,
+        decision=ShardDecision(num_shards=2, shard_index=1),
+        drop_remainder=False,
+    )
+    native_batches = list(ctr_batches_from_sources([p1, p2], **kw))
+    os.environ["DEEPFM_NO_NATIVE"] = "1"
+    try:
+        py_batches = list(ctr_batches_from_sources([p1, p2], **kw))
+    finally:
+        del os.environ["DEEPFM_NO_NATIVE"]
+    assert len(native_batches) == len(py_batches)
+    for nb, pb in zip(native_batches, py_batches):
+        for k in ("feat_ids", "feat_vals", "label"):
+            np.testing.assert_array_equal(nb[k], pb[k])
+
+
+def test_fifo_streaming(tmp_path):
+    """The PipeModeDataset capability: consume records from a FIFO while a
+    writer is still producing them."""
+    recs = _make_records(40, seed=5)
+    fifo = str(tmp_path / "training")
+    os.mkfifo(fifo)
+
+    def writer():
+        with open(fifo, "wb") as f:
+            for r in recs:
+                f.write(frame_record(r))
+                f.flush()
+
+    t = threading.Thread(target=writer)
+    t.start()
+    batches = list(
+        native.NativeCtrReader(
+            [fifo], batch_size=8, field_size=FIELD, drop_remainder=False
+        )
+    )
+    t.join()
+    assert sum(len(b["label"]) for b in batches) == 40
+    feats, labels = decode_ctr_batch(recs, FIELD)
+    np.testing.assert_array_equal(
+        np.concatenate([b["feat_ids"] for b in batches]), feats["feat_ids"]
+    )
+    np.testing.assert_array_equal(
+        np.concatenate([b["label"] for b in batches]), labels
+    )
+
+
+def test_corrupt_crc_detected(tmp_path):
+    recs = _make_records(3, seed=6)
+    blob = b"".join(frame_record(r) for r in recs)
+    corrupted = bytearray(blob)
+    corrupted[len(blob) // 2] ^= 0xFF  # flip a payload byte mid-stream
+    p = tmp_path / "bad.tfrecords"
+    p.write_bytes(bytes(corrupted))
+    with pytest.raises(native.NativeReaderError):
+        list(native.read_records(str(p)))
+
+
+def test_missing_file_errors():
+    with pytest.raises(native.NativeReaderError):
+        list(native.read_records("/nonexistent/path.tfrecords"))
+
+
+def test_field_size_mismatch_errors(tmp_path):
+    p = _write(tmp_path, "a.tfrecords", _make_records(4, field=5))
+    with pytest.raises(native.NativeReaderError, match="ids count"):
+        list(native.NativeCtrReader([p], batch_size=4, field_size=9))
+
+
+def test_reference_val_tfrecords_parity(reference_val_tfrecords):
+    """Golden test against the reference repo's bundled 10k-record file."""
+    p = str(reference_val_tfrecords)
+    batches = list(
+        native.NativeCtrReader(
+            [p], batch_size=2048, field_size=39, drop_remainder=False
+        )
+    )
+    n = sum(len(b["label"]) for b in batches)
+    assert n == 10_000
+    # spot-check the first batch against the Python proto parser
+    recs = []
+    for r in read_records(p):
+        recs.append(r)
+        if len(recs) == 2048:
+            break
+    feats, labels = decode_ctr_batch(recs, 39)
+    np.testing.assert_array_equal(batches[0]["feat_ids"], feats["feat_ids"])
+    np.testing.assert_array_equal(batches[0]["feat_vals"], feats["feat_vals"])
+    np.testing.assert_array_equal(batches[0]["label"], labels)
