@@ -63,6 +63,9 @@ class ModelConfig:
     temperature: float = 0.05
     # compute dtype for the MLP/FM math (params stay f32; bf16 feeds the MXU)
     compute_dtype: str = "bfloat16"
+    # Pallas fused gather+FM kernel (ops/pallas_ctr.py): "off" | "auto" | "on".
+    # "auto" uses it on TPU backends; "on" forces it (interpret mode on CPU).
+    fused_kernel: str = "off"
 
     def __post_init__(self):
         object.__setattr__(self, "deep_layers", _parse_int_list(self.deep_layers))
@@ -73,6 +76,11 @@ class ModelConfig:
             raise ValueError(
                 f"dropout_keep has {len(self.dropout_keep)} entries for "
                 f"{len(self.deep_layers)} deep layers"
+            )
+        if self.fused_kernel not in ("off", "auto", "on"):
+            raise ValueError(
+                f"fused_kernel must be 'off', 'auto' or 'on', "
+                f"got {self.fused_kernel!r}"
             )
 
 

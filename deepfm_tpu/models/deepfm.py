@@ -28,6 +28,7 @@ from ..ops.batch_norm import batch_norm, bn_init
 from ..ops.embedding import dense_lookup, scaled_embedding
 from ..ops.fm import fm_first_order, fm_second_order
 from ..ops.initializers import glorot_normal, glorot_uniform
+from ..ops.pallas_ctr import fused_ctr_interaction, resolve_fused
 from .base import register_model
 
 
@@ -97,10 +98,19 @@ def apply_mlp(
 
 def init_deepfm(key: jax.Array, cfg: ModelConfig) -> tuple[dict, dict]:
     k_w, k_v, k_mlp = jax.random.split(key, 3)
+    fm_v = glorot_normal(k_v, (cfg.feature_size, cfg.embedding_size))  # ps:192-198
+    if resolve_fused(cfg.fused_kernel) and 128 % cfg.embedding_size == 0:
+        # pre-pad to an aligned-window multiple with zero rows so the Pallas
+        # wrapper never re-pads the table inside the per-step forward; the
+        # rows are never gathered (ids clip to feature_size-1) and stay zero
+        # under training (zero grads -> zero Adam updates, zero L2)
+        pad = (-cfg.feature_size) % (128 // cfg.embedding_size)
+        if pad:
+            fm_v = jnp.pad(fm_v, ((0, pad), (0, 0)))
     params = {
         "fm_b": jnp.zeros((1,), jnp.float32),                      # ps:186-188
         "fm_w": glorot_normal(k_w, (cfg.feature_size,)),           # ps:189-191
-        "fm_v": glorot_normal(k_v, (cfg.feature_size, cfg.embedding_size)),  # ps:192-198
+        "fm_v": fm_v,
         "mlp": init_mlp(k_mlp, cfg.field_size * cfg.embedding_size, cfg),
     }
     state: dict = {}
@@ -127,16 +137,23 @@ def apply_deepfm(
     feat_ids = feat_ids.reshape(-1, cfg.field_size)
     feat_vals = feat_vals.reshape(-1, cfg.field_size).astype(jnp.float32)
 
-    # first order (ps:206-209)
-    feat_w = lookup_fn(params["fm_w"], feat_ids)            # [B, F]
-    y_w = fm_first_order(feat_w, feat_vals)
-
-    # second order (ps:211-217): e = V[ids] * vals
-    if lookup_fn is dense_lookup:
-        emb = scaled_embedding(params["fm_v"], feat_ids, feat_vals)
+    if lookup_fn is dense_lookup and resolve_fused(cfg.fused_kernel):
+        # one HBM pass: both gathers + scaling + FM sums (ops/pallas_ctr.py)
+        emb, y_w, y_v = fused_ctr_interaction(
+            params["fm_w"], params["fm_v"], feat_ids, feat_vals,
+            jax.default_backend() != "tpu",  # interpret on CPU (tests)
+        )
     else:
-        emb = lookup_fn(params["fm_v"], feat_ids) * feat_vals[..., None]
-    y_v = fm_second_order(emb)
+        # first order (ps:206-209)
+        feat_w = lookup_fn(params["fm_w"], feat_ids)        # [B, F]
+        y_w = fm_first_order(feat_w, feat_vals)
+
+        # second order (ps:211-217): e = V[ids] * vals
+        if lookup_fn is dense_lookup:
+            emb = scaled_embedding(params["fm_v"], feat_ids, feat_vals)
+        else:
+            emb = lookup_fn(params["fm_v"], feat_ids) * feat_vals[..., None]
+        y_v = fm_second_order(emb)
 
     # deep tower (ps:228-255)
     deep_in = emb.reshape(emb.shape[0], cfg.field_size * cfg.embedding_size)

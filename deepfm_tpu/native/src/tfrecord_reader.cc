@@ -162,7 +162,10 @@ struct Reader {
       if (!f && !open_next_file()) return error.empty() ? 0 : -1;
       uint8_t header[12];
       size_t got = read_exactly(header, 12);
-      if (got == 0) {  // clean EOF on this file -> next source
+      if (got == 0) {
+        // 0 bytes is only a clean EOF if no stream error is pending; an I/O
+        // error at a record boundary must not silently truncate the dataset
+        if (std::ferror(f)) return fail("read error at record boundary"), -1;
         if (!open_next_file()) return error.empty() ? 0 : -1;
         continue;
       }
