@@ -1,0 +1,139 @@
+"""Collective microbenchmarks — validate the mesh/ICI story (SURVEY §5).
+
+The reference's comm stack (grpc PS, Horovod/NCCL ring) is replaced by XLA
+collectives emitted from sharding annotations; this script measures them the
+way NCCL's `all_reduce_perf` would: psum / all_gather / reduce_scatter /
+ppermute bandwidth over the mesh, plus the framework's own row-sharded
+embedding lookup (gather + psum assembly).
+
+Run on real hardware or the virtual CPU mesh:
+
+    python benchmarks/collectives.py                  # ambient devices
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python benchmarks/collectives.py --mb 16
+
+Prints one JSON line per (collective, size).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepfm_tpu.core.platform import sanitize_backend  # noqa: E402
+
+sanitize_backend()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax import lax  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+from jax import shard_map  # noqa: E402
+
+
+def _time(fn, *args, iters=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_collectives(mesh: Mesh, size_mb: float, iters: int) -> list[dict]:
+    n = mesh.devices.size
+    elems = int(size_mb * 1e6 / 4)
+    elems -= elems % (128 * n)
+    x = jnp.arange(elems, dtype=jnp.float32).reshape(n, -1)
+    sharded = jax.device_put(x, NamedSharding(mesh, P("data")))
+    results = []
+
+    cases = {
+        # bytes moved per device (ring algorithm accounting, like nccl-tests)
+        "psum": (
+            shard_map(lambda a: lax.psum(a, "data"), mesh=mesh,
+                      in_specs=P("data"), out_specs=P()),
+            2 * (n - 1) / n * elems * 4,
+        ),
+        "all_gather": (
+            shard_map(lambda a: lax.all_gather(a, "data"), mesh=mesh,
+                      in_specs=P("data"), out_specs=P(None, "data")),
+            (n - 1) / n * elems * 4,
+        ),
+        "reduce_scatter": (
+            shard_map(lambda a: lax.psum_scatter(a.reshape(-1), "data",
+                                                 tiled=True)[None, :],
+                      mesh=mesh, in_specs=P("data"), out_specs=P("data")),
+            (n - 1) / n * elems * 4,
+        ),
+        "ppermute": (
+            shard_map(
+                lambda a: lax.ppermute(
+                    a, "data", [(i, (i + 1) % n) for i in range(n)]
+                ),
+                mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+            ),
+            elems * 4 / n,
+        ),
+    }
+    for name, (fn, bytes_moved) in cases.items():
+        jfn = jax.jit(fn)
+        dt = _time(jfn, sharded, iters=iters)
+        results.append({
+            "collective": name, "devices": n, "mb": round(elems * 4 / 1e6, 2),
+            "ms": round(dt * 1e3, 4),
+            "algo_gbps": round(bytes_moved / dt / 1e9, 3),
+        })
+    return results
+
+
+def bench_sharded_lookup(mesh: Mesh, iters: int) -> dict:
+    """The framework's own hot collective: row-sharded gather + psum."""
+    from deepfm_tpu.parallel.embedding import sharded_lookup
+
+    n = mesh.devices.size
+    v, k, b, f = 131_072, 32, 1024, 39
+    table = jax.device_put(
+        np.random.default_rng(0).normal(size=(v, k)).astype(np.float32),
+        NamedSharding(mesh, P("model")),
+    )
+    ids = jax.device_put(
+        np.random.default_rng(1).integers(0, v, size=(b, f)).astype(np.int32),
+        NamedSharding(mesh, P()),
+    )
+    fn = jax.jit(shard_map(
+        lambda t, i: sharded_lookup(t, i, axis_name="model"),
+        mesh=mesh, in_specs=(P("model"), P()), out_specs=P(),
+    ))
+    dt = _time(fn, table, ids, iters=iters)
+    return {
+        "collective": "sharded_embedding_lookup", "devices": n,
+        "rows": b * f, "k": k, "ms": round(dt * 1e3, 4),
+        "lookups_per_sec": round(b * f / dt, 1),
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--mb", type=float, default=64.0, help="payload size in MB")
+    p.add_argument("--iters", type=int, default=20)
+    args = p.parse_args()
+
+    devices = np.array(jax.devices())
+    with Mesh(devices.reshape(-1), ("data",)) as mesh:
+        for row in bench_collectives(mesh, args.mb, args.iters):
+            print(json.dumps(row))
+    with Mesh(devices.reshape(-1), ("model",)) as mesh:
+        print(json.dumps(bench_sharded_lookup(mesh, args.iters)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -163,6 +163,10 @@ class RunConfig:
     keep_checkpoints: int = 3
     seed: int = 0
     profile_dir: str = ""             # jax.profiler trace dir ("" = off)
+    # in-process crash retries with resume-from-checkpoint (the spot-retry
+    # analog of use_spot_instances/max_wait, both notebooks cell 4)
+    max_restarts: int = 0
+    restart_backoff_secs: float = 5.0
 
     @property
     def host_rank(self) -> int:

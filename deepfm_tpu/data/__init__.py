@@ -4,6 +4,13 @@ from .example_proto import (  # noqa: F401
     serialize_ctr_example,
     serialize_example,
 )
+from .criteo import (  # noqa: F401
+    CriteoHashEncoder,
+    CriteoVocabEncoder,
+    build_criteo_vocab,
+    convert_criteo_to_tfrecords,
+    parse_criteo_line,
+)
 from .libsvm import generate_synthetic_ctr, libsvm_to_tfrecord, tfrecord_to_libsvm  # noqa: F401
 from .sharding import ShardDecision, WorkerTopology, shard_plan, shard_records  # noqa: F401
 from .tfrecord import TFRecordWriter, crc32c, masked_crc32c, read_records, write_records  # noqa: F401

@@ -1,0 +1,235 @@
+"""Criteo click-log (Kaggle / Terabyte TSV) -> TFRecord conversion.
+
+The reference ships only a libsvm converter (tools/libsvm_to_tfrecord.py) and
+assumes the Criteo data was already preprocessed offline into libsvm — the
+encoding visible in its sample line (ps:110): numeric fields keep per-field
+ids 1..13 with scaled continuous values, categorical fields get vocabulary
+ids >= 14 with value 1.0.  This module owns that missing preprocessing step
+for the raw Criteo TSV format (BASELINE.json configs 2-3):
+
+    label \\t I1..I13 \\t C1..C26          (fields may be empty)
+
+Two encoders, both producing the reference schema
+(label f32, ids i64[39], values f32[39]):
+
+- :class:`CriteoHashEncoder` — stateless feature hashing: categorical id =
+  14 + hash64(field, token) % (feature_size - 14).  Streams at any scale
+  (the Criteo-1TB path), no vocab pass, collision rate set by feature_size.
+- :class:`CriteoVocabEncoder` — two-pass dictionary encoding with a
+  min-count threshold (the classic Kaggle-DeepFM prep): rare/unseen tokens
+  fall back to a per-field OOV id.  ``build_criteo_vocab`` does the counting
+  pass and reports the resulting feature_size.
+
+Numeric transform (both): value = log1p(x) for x >= 0, raw negative values
+kept as-is (Criteo has a few); missing numeric -> 0.0.  Missing categorical
+-> the per-field "missing" token, so every record has exactly 39 fields,
+matching the fixed [B, 39] parse (ps:119-125).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import os
+from collections import Counter
+from typing import IO, Iterable, Iterator
+
+from .tfrecord import TFRecordWriter
+from .example_proto import serialize_ctr_example
+
+NUM_NUMERIC = 13
+NUM_CATEGORICAL = 26
+FIELD_SIZE = NUM_NUMERIC + NUM_CATEGORICAL
+# ids 0..13: id 0 is the pad id (libsvm.pad_to_field_size), 1..13 numeric
+FIRST_CAT_ID = NUM_NUMERIC + 1
+
+
+def parse_criteo_line(line: str) -> tuple[float, list[str], list[str]]:
+    """Split one TSV line into (label, 13 numeric strs, 26 categorical strs).
+
+    Empty fields stay as '' — encoders decide the missing-value policy."""
+    parts = line.rstrip("\n").split("\t")
+    if len(parts) != 1 + FIELD_SIZE:
+        raise ValueError(
+            f"expected {1 + FIELD_SIZE} tab-separated fields, got {len(parts)}"
+        )
+    return float(parts[0]), parts[1:1 + NUM_NUMERIC], parts[1 + NUM_NUMERIC:]
+
+
+def numeric_value(raw: str) -> float:
+    """log1p squashing of the heavy-tailed counts; missing -> 0.0."""
+    if not raw:
+        return 0.0
+    x = float(raw)
+    return math.log1p(x) if x >= 0 else x
+
+
+def _hash64(field: int, token: str) -> int:
+    h = hashlib.blake2b(
+        f"{field}:{token}".encode(), digest_size=8
+    ).digest()
+    return int.from_bytes(h, "little")
+
+
+class CriteoHashEncoder:
+    """Stateless hashing encoder — one pass, any scale."""
+
+    def __init__(self, feature_size: int):
+        if feature_size <= FIRST_CAT_ID + NUM_CATEGORICAL:
+            raise ValueError(
+                f"feature_size {feature_size} leaves no categorical hash space"
+            )
+        self.feature_size = feature_size
+        self._buckets = feature_size - FIRST_CAT_ID
+
+    def encode(self, line: str) -> tuple[float, list[int], list[float]]:
+        label, numeric, cats = parse_criteo_line(line)
+        ids = list(range(1, NUM_NUMERIC + 1))
+        values = [numeric_value(x) for x in numeric]
+        for j, tok in enumerate(cats):
+            # '' hashes like any token: a stable per-field "missing" id
+            ids.append(FIRST_CAT_ID + _hash64(j, tok) % self._buckets)
+            values.append(1.0)
+        return label, ids, values
+
+
+def build_criteo_vocab(
+    lines: Iterable[str], *, min_count: int = 10
+) -> dict:
+    """Counting pass: per-field token -> contiguous id, rare tokens dropped.
+
+    Returns a JSON-serializable dict with ``mapping`` (per-field token->id),
+    ``oov`` (per-field OOV id) and ``feature_size``.  Layout: numeric 1..13,
+    then per-field [kept tokens..., OOV] blocks — matching the contiguous
+    small-vocab encoding the reference's 117,581 feature_size implies."""
+    counters = [Counter() for _ in range(NUM_CATEGORICAL)]
+    for line in lines:
+        _, _, cats = parse_criteo_line(line)
+        for j, tok in enumerate(cats):
+            counters[j][tok] += 1
+    next_id = FIRST_CAT_ID
+    mapping: list[dict[str, int]] = []
+    oov: list[int] = []
+    for j in range(NUM_CATEGORICAL):
+        field_map = {}
+        for tok, cnt in sorted(counters[j].items()):
+            if cnt >= min_count:
+                field_map[tok] = next_id
+                next_id += 1
+        mapping.append(field_map)
+        oov.append(next_id)  # one OOV id per field, after its kept block
+        next_id += 1
+    return {"mapping": mapping, "oov": oov, "feature_size": next_id}
+
+
+class CriteoVocabEncoder:
+    """Dictionary encoder driven by a ``build_criteo_vocab`` result."""
+
+    def __init__(self, vocab: dict):
+        self.mapping = vocab["mapping"]
+        self.oov = vocab["oov"]
+        self.feature_size = vocab["feature_size"]
+
+    @classmethod
+    def from_json(cls, path: str | os.PathLike) -> "CriteoVocabEncoder":
+        with open(path) as f:
+            return cls(json.load(f))
+
+    def save(self, path: str | os.PathLike) -> None:
+        with open(path, "w") as f:
+            json.dump(
+                {"mapping": self.mapping, "oov": self.oov,
+                 "feature_size": self.feature_size}, f
+            )
+
+    def encode(self, line: str) -> tuple[float, list[int], list[float]]:
+        label, numeric, cats = parse_criteo_line(line)
+        ids = list(range(1, NUM_NUMERIC + 1))
+        values = [numeric_value(x) for x in numeric]
+        for j, tok in enumerate(cats):
+            ids.append(self.mapping[j].get(tok, self.oov[j]))
+            values.append(1.0)
+        return label, ids, values
+
+
+def convert_criteo_to_tfrecords(
+    input_path: str | os.PathLike,
+    output_dir: str | os.PathLike,
+    encoder,
+    *,
+    records_per_shard: int = 1_000_000,
+    prefix: str = "tr",
+) -> list[str]:
+    """Stream a Criteo TSV into sharded TFRecord files ``{prefix}-NNNNN``.
+
+    Sharded output is what feeds the 4-way shard matrix (README.md:87-92):
+    per-host file assignment needs file counts divisible by the host count
+    (README.md:67), which one giant file would preclude."""
+    os.makedirs(output_dir, exist_ok=True)
+    paths: list[str] = []
+    writer: TFRecordWriter | None = None
+    in_shard = 0
+    with open(input_path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            if writer is None or in_shard >= records_per_shard:
+                if writer is not None:
+                    writer.close()
+                path = os.path.join(
+                    output_dir, f"{prefix}-{len(paths):05d}.tfrecords"
+                )
+                paths.append(path)
+                writer = TFRecordWriter(path)
+                in_shard = 0
+            label, ids, values = encoder.encode(line)
+            writer.write(serialize_ctr_example(label, ids, values))
+            in_shard += 1
+    if writer is not None:
+        writer.close()
+    return paths
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m deepfm_tpu.data.criteo",
+        description="Convert raw Criteo TSV to DeepFM TFRecords",
+    )
+    p.add_argument("input", help="Criteo TSV file (label + 13 ints + 26 cats)")
+    p.add_argument("output_dir")
+    p.add_argument("--encoder", choices=["hash", "vocab"], default="hash")
+    p.add_argument("--feature_size", type=int, default=117_581,
+                   help="hash space size (hash encoder)")
+    p.add_argument("--min_count", type=int, default=10,
+                   help="vocab min token count (vocab encoder)")
+    p.add_argument("--vocab_json", help="reuse/save the vocab here")
+    p.add_argument("--records_per_shard", type=int, default=1_000_000)
+    p.add_argument("--prefix", default="tr")
+    args = p.parse_args(argv)
+
+    if args.encoder == "hash":
+        enc = CriteoHashEncoder(args.feature_size)
+    elif args.vocab_json and os.path.exists(args.vocab_json):
+        enc = CriteoVocabEncoder.from_json(args.vocab_json)
+    else:
+        with open(args.input) as f:
+            vocab = build_criteo_vocab(f, min_count=args.min_count)
+        enc = CriteoVocabEncoder(vocab)
+        if args.vocab_json:
+            enc.save(args.vocab_json)
+    paths = convert_criteo_to_tfrecords(
+        args.input, args.output_dir, enc,
+        records_per_shard=args.records_per_shard, prefix=args.prefix,
+    )
+    print(json.dumps({
+        "shards": len(paths), "feature_size": enc.feature_size,
+        "encoder": args.encoder,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -76,8 +76,14 @@ def batched_ctr_batches(
     field_size: int,
     drop_remainder: bool = True,
     permute_vocab: int = 0,
+    skip_counter: list[int] | None = None,
 ) -> Iterator[dict]:
-    """batch -> vectorized decode -> feature dict (ps:158-161 ordering)."""
+    """batch -> vectorized decode -> feature dict (ps:158-161 ordering).
+
+    ``skip_counter``: single-element mutable counter of whole batches to
+    fast-forward past (input-position resume).  Skipped batches are counted
+    at the raw-record level and never proto-decoded; the counter is shared
+    across epoch iterators so the caller can spread a skip over epochs."""
     from ..parallel.embedding import permute_ids
 
     def emit(buf: list[bytes]) -> dict:
@@ -87,8 +93,15 @@ def batched_ctr_batches(
             ids = permute_ids(ids, permute_vocab, True)
         return {"feat_ids": ids, "feat_vals": feats["feat_vals"], "label": labels}
 
+    n_buf = 0
     buf: list[bytes] = []
     for rec in records:
+        if skip_counter is not None and skip_counter[0] > 0:
+            n_buf += 1
+            if n_buf == batch_size:
+                skip_counter[0] -= 1
+                n_buf = 0
+            continue
         buf.append(rec)
         if len(buf) == batch_size:
             yield emit(buf)
@@ -106,6 +119,7 @@ def ctr_batches_from_sources(
     drop_remainder: bool = True,
     permute_vocab: int = 0,
     verify_crc: bool | None = None,
+    skip_counter: list[int] | None = None,
 ) -> Iterator[dict]:
     """Source files/FIFOs -> decoded batches, via the C++ reader when built.
 
@@ -134,6 +148,7 @@ def ctr_batches_from_sources(
             shard_i=shard_i,
             drop_remainder=drop_remainder,
             verify=True if verify_crc is None else verify_crc,
+            skip_counter=skip_counter,
         )
         for b in reader:
             if permute_vocab:
@@ -146,6 +161,7 @@ def ctr_batches_from_sources(
         field_size=field_size,
         drop_remainder=drop_remainder,
         permute_vocab=permute_vocab,
+        skip_counter=skip_counter,
     )
 
 
@@ -222,9 +238,15 @@ def make_input_pipeline(
     num_epochs: int | None = None,
     feature_size: int = 0,
     seed: int = 0,
+    skip_batches: int = 0,
 ) -> Iterator[dict]:
     """The ``input_fn`` equivalent (ps:112-169): wire the shard matrix, the
-    source mode (file glob vs stream FIFO), batching and epochs together."""
+    source mode (file glob vs stream FIFO), batching and epochs together.
+
+    ``skip_batches`` fast-forwards the deterministic file-mode stream past
+    batches an interrupted run already consumed (raw-record level, no
+    decode), spread across epochs.  Stream mode ignores it — a live FIFO
+    delivers fresh, never-repeated data, so there is nothing to replay."""
     decision = shard_plan(
         topo,
         stream_mode=cfg.stream_mode,
@@ -257,6 +279,7 @@ def make_input_pipeline(
         raise FileNotFoundError(
             f"no {tuple(cfg.file_patterns)}*.tfrecords under {base_dir!r}"
         )
+    skip_counter = [max(0, skip_batches)]
     for _ in range(max(1, epochs)):
         yield from ctr_batches_from_sources(
             files,
@@ -265,6 +288,7 @@ def make_input_pipeline(
             decision=decision,
             drop_remainder=cfg.drop_remainder,
             permute_vocab=permute_vocab,
+            skip_counter=skip_counter,
         )
 
 

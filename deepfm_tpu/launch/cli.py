@@ -139,9 +139,28 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps(cfg.to_dict(), indent=2))
         return 0
     sanitize_backend()
+    from ..checkpoint import maybe_clear
     from ..train.loop import run_task
+    from ..utils import MetricLogger
+    from .preemption import PreemptedError, run_with_restarts
 
-    run_task(cfg)
+    # clear ONCE, before the supervisor loop: a crash retry must resume from
+    # the latest checkpoint, not re-wipe the model_dir it needs to resume from
+    maybe_clear(cfg.run.model_dir, cfg.run.clear_existing_model)
+    cfg = cfg.with_overrides(run={"clear_existing_model": False})
+    try:
+        run_with_restarts(
+            lambda: run_task(cfg),
+            max_restarts=cfg.run.max_restarts,
+            backoff_secs=cfg.run.restart_backoff_secs,
+            on_restart=lambda attempt, e: MetricLogger().event(
+                "restart", attempt=attempt, error=f"{type(e).__name__}: {e}"[:200]
+            ),
+        )
+    except PreemptedError:
+        # checkpointed and ready to resume; exit 0 so the platform's
+        # reschedule (not a crash handler) brings the job back
+        return 0
     return 0
 
 

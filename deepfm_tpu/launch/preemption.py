@@ -1,0 +1,113 @@
+"""Preemption tolerance: signal-triggered checkpoint + restart-with-resume.
+
+The reference delegates fault handling entirely to the platform: SageMaker
+spot training (``use_spot_instances=True, max_wait=72000`` — both notebooks
+cell 4) restarts interrupted jobs, and resume works because the Estimator
+``model_dir`` lives on S3 (ps notebook cell 4, README.md:63).  SURVEY §5
+calls the TPU-native equivalent out explicitly: a preemption-aware launcher
+plus resume-from-latest-checkpoint.
+
+Two pieces, composable:
+
+- :class:`PreemptionGuard` — context manager that converts SIGTERM/SIGINT
+  (what TPU-VM maintenance events and cluster managers deliver) into a
+  cooperative ``should_stop`` flag the train loop polls once per step.  The
+  loop then saves a final checkpoint and exits 0; the next run of the same
+  command resumes from it (run_train restores ``latest_step`` on startup).
+- :func:`run_with_restarts` — in-process supervisor loop: re-invokes the
+  task after a crash up to ``max_restarts`` times (the spot-retry analog for
+  transient failures).  Signal-triggered stops exit cleanly and are NOT
+  retried — the platform that sent the signal owns the reschedule.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+from typing import Callable, TypeVar
+
+T = TypeVar("T")
+
+_DEFAULT_SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+
+class PreemptionGuard:
+    """Cooperative stop flag set by termination signals.
+
+    Only the main thread may install signal handlers, so constructing this
+    off-thread degrades to a manually-settable flag (``request_stop``),
+    which is also what unit tests use.
+    """
+
+    def __init__(self, signals=_DEFAULT_SIGNALS):
+        self._signals = tuple(signals)
+        self._stop = threading.Event()
+        self._prev: dict[int, object] = {}
+        self._installed = False
+        self.signaled_at: float | None = None
+
+    # -- context manager ---------------------------------------------------
+
+    def __enter__(self) -> "PreemptionGuard":
+        if threading.current_thread() is threading.main_thread():
+            for sig in self._signals:
+                self._prev[sig] = signal.signal(sig, self._handle)
+            self._installed = True
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._installed:
+            for sig, prev in self._prev.items():
+                signal.signal(sig, prev)
+            self._prev.clear()
+            self._installed = False
+
+    # -- flag --------------------------------------------------------------
+
+    def _handle(self, signum, frame) -> None:
+        self.signaled_at = time.time()
+        self._stop.set()
+
+    def request_stop(self) -> None:
+        """Set the flag without a signal (tests, cooperative shutdown)."""
+        self.signaled_at = time.time()
+        self._stop.set()
+
+    @property
+    def should_stop(self) -> bool:
+        return self._stop.is_set()
+
+
+class PreemptedError(RuntimeError):
+    """Raised by tasks that stopped on a preemption signal, so supervisors
+    can distinguish clean-preempted exits from crashes."""
+
+
+def run_with_restarts(
+    fn: Callable[[], T],
+    *,
+    max_restarts: int = 0,
+    backoff_secs: float = 5.0,
+    on_restart: Callable[[int, BaseException], None] | None = None,
+) -> T:
+    """Run ``fn``, retrying after crashes up to ``max_restarts`` times.
+
+    ``PreemptedError`` and ``KeyboardInterrupt`` propagate immediately (the
+    sender owns the reschedule); any other exception triggers a retry after
+    ``backoff_secs``.  Each retry resumes from the latest checkpoint because
+    the train tasks restore on startup.
+    """
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except (PreemptedError, KeyboardInterrupt):
+            raise
+        except Exception as e:
+            attempt += 1
+            if attempt > max_restarts:
+                raise
+            if on_restart is not None:
+                on_restart(attempt, e)
+            time.sleep(backoff_secs)

@@ -99,11 +99,14 @@ def apply_mlp(
 def init_deepfm(key: jax.Array, cfg: ModelConfig) -> tuple[dict, dict]:
     k_w, k_v, k_mlp = jax.random.split(key, 3)
     fm_v = glorot_normal(k_v, (cfg.feature_size, cfg.embedding_size))  # ps:192-198
-    if resolve_fused(cfg.fused_kernel) and 128 % cfg.embedding_size == 0:
+    if cfg.fused_kernel != "off" and 128 % cfg.embedding_size == 0:
         # pre-pad to an aligned-window multiple with zero rows so the Pallas
         # wrapper never re-pads the table inside the per-step forward; the
         # rows are never gathered (ids clip to feature_size-1) and stay zero
-        # under training (zero grads -> zero Adam updates, zero L2)
+        # under training (zero grads -> zero Adam updates, zero L2).
+        # Deliberately keyed on the config value, NOT resolve_fused(): the
+        # checkpointed table shape must not depend on which backend happened
+        # to run init ("auto" on TPU vs a later CPU export/infer restore)
         pad = (-cfg.feature_size) % (128 // cfg.embedding_size)
         if pad:
             fm_v = jnp.pad(fm_v, ((0, pad), (0, 0)))
@@ -137,7 +140,15 @@ def apply_deepfm(
     feat_ids = feat_ids.reshape(-1, cfg.field_size)
     feat_vals = feat_vals.reshape(-1, cfg.field_size).astype(jnp.float32)
 
-    if lookup_fn is dense_lookup and resolve_fused(cfg.fused_kernel):
+    use_fused = lookup_fn is dense_lookup and resolve_fused(cfg.fused_kernel)
+    if use_fused and 128 % cfg.embedding_size != 0:
+        if cfg.fused_kernel == "on":
+            raise ValueError(
+                f"fused_kernel='on' needs embedding_size dividing 128, "
+                f"got {cfg.embedding_size}"
+            )
+        use_fused = False  # "auto": quietly keep the XLA gather path
+    if use_fused:
         # one HBM pass: both gathers + scaling + FM sums (ops/pallas_ctr.py)
         emb, y_w, y_v = fused_ctr_interaction(
             params["fm_w"], params["fm_v"], feat_ids, feat_vals,

@@ -196,6 +196,7 @@ class NativeCtrReader:
         shard_i: int = 0,
         drop_remainder: bool = True,
         verify: bool = True,
+        skip_counter: list[int] | None = None,
     ):
         self._paths = list(paths)
         self._batch = batch_size
@@ -203,12 +204,28 @@ class NativeCtrReader:
         self._shard = (shard_n, shard_i)
         self._drop = drop_remainder
         self._verify = verify
+        self._skip_counter = skip_counter
 
     def __iter__(self) -> Iterator[dict]:
         h = _Handle(self._paths, self._verify, *self._shard)
         lib = h._lib
         B, F = self._batch, self._fields
         try:
+            # input-position resume: fast-forward whole batches at the raw-
+            # record level (framing+CRC only, no Example decode, no copies).
+            # The shared counter lets the caller spread a skip across epochs;
+            # a partial tail doesn't decrement it (drop_remainder parity).
+            ptr = ctypes.POINTER(ctypes.c_uint8)()
+            while self._skip_counter and self._skip_counter[0] > 0:
+                pulled = 0
+                while pulled < B:
+                    n = lib.dfm_reader_next_record(h._h, ctypes.byref(ptr))
+                    if n == -1:
+                        return
+                    if n < 0:
+                        raise NativeReaderError(h.error())
+                    pulled += 1
+                self._skip_counter[0] -= 1
             while True:
                 ids = np.empty((B, F), np.int64)
                 vals = np.empty((B, F), np.float32)

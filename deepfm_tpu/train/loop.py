@@ -12,6 +12,7 @@ train_and_evaluate + export flow (ps:501-521, 535-551).
 from __future__ import annotations
 
 import contextlib
+import itertools
 import os
 from typing import Iterator
 
@@ -20,6 +21,7 @@ import numpy as np
 
 from ..checkpoint import Checkpointer, maybe_clear
 from ..core.config import Config
+from ..launch.preemption import PreemptedError, PreemptionGuard
 from ..data.pipeline import DevicePrefetcher, InMemoryDataset, discover_files, make_input_pipeline
 from ..data.sharding import WorkerTopology
 from ..ops.auc import auc_value
@@ -55,7 +57,9 @@ def setup(cfg: Config) -> SPMDContext:
     return make_context(cfg, mesh)
 
 
-def _train_batches(cfg: Config, ctx: SPMDContext) -> DevicePrefetcher:
+def _train_batches(
+    cfg: Config, ctx: SPMDContext, *, skip_batches: int = 0
+) -> DevicePrefetcher:
     topo = worker_topology(cfg)
     batches = make_input_pipeline(
         cfg.data,
@@ -65,6 +69,11 @@ def _train_batches(cfg: Config, ctx: SPMDContext) -> DevicePrefetcher:
         data_dir=cfg.data.training_data_dir,
         feature_size=ctx.true_feature_size,
         seed=cfg.run.seed,
+        # input-position resume: the file-mode stream is deterministic (file
+        # order and shuffles are seed-derived), so the pipeline fast-forwards
+        # past already-consumed batches at the raw-record level; stream mode
+        # (live FIFO, fresh data) ignores the skip inside make_input_pipeline
+        skip_batches=skip_batches,
     )
     return DevicePrefetcher(
         batches, lambda b: shard_batch(ctx, b), depth=cfg.data.prefetch_batches
@@ -152,7 +161,8 @@ def run_train(cfg: Config) -> TrainState:
     # host-side step counter: int(state.step) every iteration would block on
     # the just-dispatched step and defeat async-dispatch pipelining
     step = int(state.step)
-    with profile_cm, _train_batches(cfg, ctx) as batches:
+    guard = PreemptionGuard()
+    with profile_cm, guard, _train_batches(cfg, ctx, skip_batches=step) as batches:
         for batch in batches:
             batch_size = int(batch["label"].shape[0])
             state, metrics = train_step(state, batch)
@@ -161,8 +171,20 @@ def run_train(cfg: Config) -> TrainState:
                                         if k != "loss_per_shard"})
             if cfg.run.checkpoint_every_steps and step % cfg.run.checkpoint_every_steps == 0:
                 ckpt.save(state)
+            if guard.should_stop:
+                break
 
     ckpt.save(state)
+    if guard.should_stop:
+        # spot/maintenance interruption: persist and stop without the final
+        # eval/export — the next run of the same command resumes from this
+        # checkpoint (restore-on-startup above).  Raising (rather than
+        # returning) lets supervisors distinguish preemption from completion;
+        # the CLI converts it to a clean exit 0, and run_with_restarts never
+        # retries it (the platform that sent the signal owns the reschedule)
+        log.event("preempted", step=step)
+        ckpt.close()
+        raise PreemptedError(f"preempted at step {step}")
     if cfg.data.val_data_dir:
         run_eval(cfg, ctx, state, log)
     if cfg.run.servable_model_dir:
@@ -274,7 +296,13 @@ def run_retrieval_train(cfg: Config) -> TrainState:
         num_epochs=cfg.data.num_epochs, shuffle=True,
     )
     step = int(state.step)
-    with DevicePrefetcher(
+    if step:
+        # input-position resume (same contract as _train_batches): the
+        # ratings batch stream is seed-deterministic, so skip what the
+        # interrupted run already consumed
+        batches = itertools.islice(batches, step, None)
+    guard = PreemptionGuard()
+    with guard, DevicePrefetcher(
         # validate_ids=False: _retrieval_batches already range-checked the
         # whole dataset against both vocabs
         batches, lambda b: shard_retrieval_batch(ctx, b, validate_ids=False),
@@ -287,8 +315,14 @@ def run_retrieval_train(cfg: Config) -> TrainState:
             log.step(step, batch_size, metrics)
             if cfg.run.checkpoint_every_steps and step % cfg.run.checkpoint_every_steps == 0:
                 ckpt.save(state)
+            if guard.should_stop:
+                break
 
     ckpt.save(state)
+    if guard.should_stop:
+        log.event("preempted", step=step)
+        ckpt.close()
+        raise PreemptedError(f"preempted at step {step}")
     if cfg.data.val_data_dir:
         run_retrieval_eval(cfg, ctx, state, log)
     if cfg.run.servable_model_dir:

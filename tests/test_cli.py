@@ -107,12 +107,22 @@ def test_full_lifecycle_train_eval_export_infer(data_dir, tmp_path, capsys):
     assert 0.0 <= evals[-1]["auc"] <= 1.0
     assert os.path.exists(servable / "config.json")
 
-    # resume: step counter continues past the first run's 16 steps
+    # rerun of the completed job: input-position resume skips the already-
+    # consumed stream, so no extra training happens (planned work runs once)
     rc = main(_common_args(data_dir, tmp_path) + ["--task_type", "train"])
     assert rc == 0
     out_lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
     resume = [l for l in out_lines if l["kind"] == "resume"]
     assert resume and resume[0]["step"] == 16
+    assert not [l for l in out_lines if l["kind"] == "train"]
+
+    # extending the plan (num_epochs 2 -> 4) resumes at 16 and trains to 32
+    rc = main(
+        _common_args(data_dir, tmp_path)
+        + ["--task_type", "train", "--num_epochs", "4"]
+    )
+    assert rc == 0
+    out_lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
     steps = [l["step"] for l in out_lines if l["kind"] == "train"]
     assert max(steps) == 32
 

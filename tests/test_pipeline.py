@@ -169,3 +169,31 @@ def test_device_prefetcher_order_and_errors():
     assert next(pf) == 1
     with pytest.raises(RuntimeError, match="reader died"):
         next(pf)
+
+
+@pytest.mark.parametrize("force_python", [False, True])
+def test_skip_batches_fast_forward(tmp_path, monkeypatch, force_python):
+    """Input-position resume: skip_batches=k yields exactly the stream[k:],
+    spread across epoch boundaries, on both the native and Python paths."""
+    if force_python:
+        monkeypatch.setenv("DEEPFM_NO_NATIVE", "1")
+    _write(tmp_path, "tr-0.tfrecords", 20, seed=3)  # 20 recs, batch 8
+    cfg = DataConfig(batch_size=8, num_epochs=3, shuffle_files=False)
+    topo = WorkerTopology(1, 0, 1, 0)
+
+    def run(skip):
+        return list(make_input_pipeline(
+            cfg, topo, field_size=FIELD, data_dir=str(tmp_path),
+            skip_batches=skip,
+        ))
+
+    full = run(0)
+    assert len(full) == 6  # floor(20/8)=2 per epoch × 3 (tail dropped)
+    for skip in (1, 2, 3, 5):  # incl. a skip crossing an epoch boundary
+        resumed = run(skip)
+        assert len(resumed) == 6 - skip
+        for got, want in zip(resumed, full[skip:]):
+            np.testing.assert_array_equal(got["feat_ids"], want["feat_ids"])
+            np.testing.assert_array_equal(got["label"], want["label"])
+    assert run(6) == []   # completed job reruns as a no-op
+    assert run(99) == []  # over-skip is safe
