@@ -72,8 +72,11 @@ def main() -> None:
 
     steps = 100
 
-    def measure(fused: str) -> tuple[float, float]:
-        c = cfg.with_overrides(model={"fused_kernel": fused})
+    def measure(fused: str, lazy: bool = False) -> tuple[float, float]:
+        c = cfg.with_overrides(
+            model={"fused_kernel": fused},
+            optimizer={"lazy_embedding_updates": lazy},
+        )
         state = create_train_state(c)
         train_step = jax.jit(make_train_step(c), donate_argnums=(0,))
         for i in range(3):  # warmup (compile + first dispatches)
@@ -86,13 +89,17 @@ def main() -> None:
         dt = time.perf_counter() - t0
         return steps * batch_size / dt, float(metrics["loss"])
 
-    # auto-tune: XLA gather path vs Pallas fused-gather kernel (TPU only)
+    # auto-tune: XLA gather vs Pallas fused gather vs lazy (touched-rows)
+    # Adam — report the fastest, record all (missing key flags a breakage)
     rates = {"xla": measure("off")}
+    variants = [("lazy_adam", ("off", True))]
     if platform == "tpu":
+        variants.append(("pallas_fused", ("on", False)))
+    for name, (fused, lazy) in variants:
         try:
-            rates["pallas_fused"] = measure("on")
-        except Exception as e:  # missing variant in output flags the breakage
-            print(f"pallas_fused variant failed: {type(e).__name__}: {e}",
+            rates[name] = measure(fused, lazy)
+        except Exception as e:
+            print(f"{name} variant failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
     best = max(rates, key=lambda k: rates[k][0])
     examples_per_sec, final_loss = rates[best]

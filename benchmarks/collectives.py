@@ -55,12 +55,14 @@ def bench_collectives(mesh: Mesh, size_mb: float, iters: int) -> list[dict]:
     sharded = jax.device_put(x, NamedSharding(mesh, P("data")))
     results = []
 
+    local = elems // n  # per-device shard size, elements
     cases = {
-        # bytes moved per device (ring algorithm accounting, like nccl-tests)
+        # bytes moved per device (ring-algorithm accounting over the LOCAL
+        # operand size, the nccl-tests busbw convention)
         "psum": (
             shard_map(lambda a: lax.psum(a, "data"), mesh=mesh,
                       in_specs=P("data"), out_specs=P()),
-            2 * (n - 1) / n * elems * 4,
+            2 * (n - 1) / n * local * 4,
         ),
         "all_gather": (
             shard_map(lambda a: lax.all_gather(a, "data"), mesh=mesh,
@@ -71,7 +73,7 @@ def bench_collectives(mesh: Mesh, size_mb: float, iters: int) -> list[dict]:
             shard_map(lambda a: lax.psum_scatter(a.reshape(-1), "data",
                                                  tiled=True)[None, :],
                       mesh=mesh, in_specs=P("data"), out_specs=P("data")),
-            (n - 1) / n * elems * 4,
+            (n - 1) / n * local * 4,
         ),
         "ppermute": (
             shard_map(
@@ -80,7 +82,7 @@ def bench_collectives(mesh: Mesh, size_mb: float, iters: int) -> list[dict]:
                 ),
                 mesh=mesh, in_specs=P("data"), out_specs=P("data"),
             ),
-            elems * 4 / n,
+            local * 4,
         ),
     }
     for name, (fn, bytes_moved) in cases.items():

@@ -92,6 +92,9 @@ class OptimizerConfig:
     learning_rate: float = 0.0005     # ps:56
     # Horovod path scales lr by world size (hvd:171). Explicit knob here.
     scale_lr_by_data_parallel: bool = False
+    # touched-rows-only Adam for the embedding tables (train/lazy.py): the
+    # TF1 sparse_apply_adam capability; Adam-only, single-controller path
+    lazy_embedding_updates: bool = False
     adam_b1: float = 0.9
     adam_b2: float = 0.999
     adam_eps: float = 1e-8

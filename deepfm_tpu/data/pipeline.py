@@ -106,8 +106,13 @@ def batched_ctr_batches(
         if len(buf) == batch_size:
             yield emit(buf)
             buf = []
-    if buf and not drop_remainder:
-        yield emit(buf)
+    if not drop_remainder:
+        # a partial tail IS a step when remainders are kept, so a skip that
+        # ends mid-tail must consume it too or resume shifts by one batch
+        if skip_counter is not None and skip_counter[0] > 0 and n_buf:
+            skip_counter[0] -= 1
+        elif buf:
+            yield emit(buf)
 
 
 def ctr_batches_from_sources(

@@ -145,8 +145,11 @@ def main(argv: list[str] | None = None) -> int:
     from .preemption import PreemptedError, run_with_restarts
 
     # clear ONCE, before the supervisor loop: a crash retry must resume from
-    # the latest checkpoint, not re-wipe the model_dir it needs to resume from
-    maybe_clear(cfg.run.model_dir, cfg.run.clear_existing_model)
+    # the latest checkpoint, not re-wipe the model_dir it needs to resume
+    # from.  Train only — eval/infer/export READ the model_dir (hvd:372-378
+    # clears in the training path only)
+    if cfg.run.task_type == "train":
+        maybe_clear(cfg.run.model_dir, cfg.run.clear_existing_model)
     cfg = cfg.with_overrides(run={"clear_existing_model": False})
     try:
         run_with_restarts(

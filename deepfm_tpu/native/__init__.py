@@ -221,6 +221,10 @@ class NativeCtrReader:
                 while pulled < B:
                     n = lib.dfm_reader_next_record(h._h, ctypes.byref(ptr))
                     if n == -1:
+                        # stream ended mid-skip: with remainders kept the
+                        # partial tail counts as one skipped step
+                        if pulled and not self._drop:
+                            self._skip_counter[0] -= 1
                         return
                     if n < 0:
                         raise NativeReaderError(h.error())

@@ -76,6 +76,12 @@ def _spec_for_leaf(path, shape: tuple[int, ...], vocab: int) -> P:
 
 def _build_full_init(cfg: Config, true_vocab: int) -> Callable:
     """Initializer for the full TrainState with zeroed pad rows."""
+    if cfg.optimizer.lazy_embedding_updates:
+        raise NotImplementedError(
+            "lazy_embedding_updates runs on the single-controller path "
+            "(deepfm_tpu.train.create_train_state/make_train_step) only; "
+            "the SPMD path row-shards tables and uses dense updates"
+        )
     model = get_model(cfg.model)
     tx = build_optimizer(cfg.optimizer, data_parallel_size=cfg.mesh.data_parallel)
 

@@ -1,0 +1,139 @@
+"""Lazy (sparse) Adam for embedding tables — touched-rows-only updates.
+
+Dense Adam reads and writes the full [V, K] table plus both moments every
+step (~6·V·K·4 bytes of HBM traffic) even though a batch touches at most
+B·F rows.  At the reference vocabulary (117,581×32) that is ~90 MB/step —
+already the dominant step cost on one chip — and at the 100M-row north star
+it is simply impossible.  TF1 solved this with ``sparse_apply_adam`` over
+``IndexedSlices`` (what the reference's Adam does for its embedding gathers
+when no dense term forces densification); this module is the JAX/TPU
+equivalent:
+
+    gather rows -> grad w.r.t. ROWS (never a dense table grad)
+    sort ids -> segment-sum duplicate rows (Adam is nonlinear: one summed
+    update per unique row, not per occurrence)
+    gather m/v rows -> Adam math on [N, K] -> masked delta scatter-add
+
+Everything is fixed-shape (N = B·F with zero-masked padding segments), so
+it jits cleanly.  Semantics notes:
+
+- Moment decay is lazy (untouched rows keep stale m/v — LazyAdam semantics,
+  not bias-exact Adam).  Bias correction uses the global step.
+- L2 regularization is applied as a gradient term ``l2·w`` on touched rows
+  only, once per unique row (the reference's dense ``l2_loss`` term adds
+  ``l2·w`` to every row every step — lazy trades that for sparsity, the
+  standard lazy-regularization approximation).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.config import OptimizerConfig
+
+
+class LazyAdamState(NamedTuple):
+    m: dict        # per-table first moment, full table shape
+    v: dict        # per-table second moment, full table shape
+
+
+def init_lazy_state(tables: dict) -> LazyAdamState:
+    zeros = {k: jnp.zeros_like(t) for k, t in tables.items()}
+    return LazyAdamState(m=zeros, v={k: jnp.zeros_like(t) for k, t in tables.items()})
+
+
+def segment_rows(flat_ids: jnp.ndarray, flat_grads: jnp.ndarray):
+    """Dedup row updates: (ids [N], grads [N, K]) ->
+    (row_id [N], summed [N, K], valid [N]) where only the first U entries
+    (U = unique count) are live; the rest are zero-masked padding."""
+    order, seg, row_id, valid = shared_segments(flat_ids)
+    summed = jax.ops.segment_sum(
+        flat_grads[order], seg, num_segments=flat_ids.shape[0],
+        indices_are_sorted=True,
+    )
+    return row_id, summed, valid
+
+
+def lazy_adam_update(
+    table: jnp.ndarray,
+    m: jnp.ndarray,
+    v: jnp.ndarray,
+    ids: jnp.ndarray,
+    row_grads: jnp.ndarray,
+    step: jnp.ndarray,
+    cfg: OptimizerConfig,
+    *,
+    learning_rate: float,
+    l2_reg: float = 0.0,
+    segmented: tuple | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One lazy-Adam step on the rows of ``table`` touched by ``ids``.
+
+    table [V, ...], ids [...] int, row_grads ids.shape + table.shape[1:],
+    step: 1-based global step (for bias correction).  ``segmented`` lets the
+    caller reuse one sort across tables sharing the same ids.
+    Returns (new_table, new_m, new_v).
+    """
+    shape = table.shape
+    width = 1
+    for d in shape[1:]:
+        width *= d
+    t2 = table.reshape(shape[0], width)
+    m2 = m.reshape(shape[0], width)
+    v2 = v.reshape(shape[0], width)
+    flat_ids = jnp.clip(ids.reshape(-1), 0, shape[0] - 1)
+    g2 = row_grads.reshape(flat_ids.shape[0], width)
+
+    if segmented is None:
+        row_id, gsum, valid = segment_rows(flat_ids, g2)
+    else:
+        order, seg, row_id, valid = segmented
+        gsum = jax.ops.segment_sum(
+            g2[order], seg, num_segments=flat_ids.shape[0],
+            indices_are_sorted=True,
+        )
+
+    p_r = t2[row_id]
+    # dense-L2 analog on touched rows, once per unique row
+    if l2_reg:
+        gsum = gsum + l2_reg * p_r
+    m_r = m2[row_id]
+    v_r = v2[row_id]
+    b1, b2, eps = cfg.adam_b1, cfg.adam_b2, cfg.adam_eps
+    m_n = b1 * m_r + (1.0 - b1) * gsum
+    v_n = b2 * v_r + (1.0 - b2) * jnp.square(gsum)
+    t = step.astype(jnp.float32)
+    m_hat = m_n / (1.0 - jnp.power(b1, t))
+    v_hat = v_n / (1.0 - jnp.power(b2, t))
+    p_n = p_r - learning_rate * m_hat / (jnp.sqrt(v_hat) + eps)
+
+    # padding segments get strictly-increasing OUT-OF-BOUNDS ids: XLA drops
+    # them, and the index vector stays sorted and duplicate-free so the
+    # scatters take the fast sorted/unique path instead of the serialized
+    # conflict-safe one (the difference is ~50x on TPU)
+    n = row_id.shape[0]
+    scatter_id = jnp.where(
+        valid, row_id, shape[0] + jnp.arange(n, dtype=row_id.dtype)
+    )
+    kw = dict(indices_are_sorted=True, unique_indices=True, mode="drop")
+    new_t = t2.at[scatter_id].add(p_n - p_r, **kw)
+    new_m = m2.at[scatter_id].add(m_n - m_r, **kw)
+    new_v = v2.at[scatter_id].add(v_n - v_r, **kw)
+    return new_t.reshape(shape), new_m.reshape(shape), new_v.reshape(shape)
+
+
+def shared_segments(flat_ids: jnp.ndarray):
+    """Precompute the sort/segment structure once for tables sharing ids."""
+    n = flat_ids.shape[0]
+    order = jnp.argsort(flat_ids)
+    sid = flat_ids[order]
+    first = jnp.concatenate([jnp.ones((1,), bool), sid[1:] != sid[:-1]])
+    seg = jnp.cumsum(first) - 1
+    row_id = jnp.zeros((n,), sid.dtype).at[seg].set(
+        sid, indices_are_sorted=True
+    )
+    valid = jnp.arange(n) < jnp.sum(first)
+    return order, seg, row_id, valid

@@ -14,6 +14,7 @@ from __future__ import annotations
 import contextlib
 import itertools
 import os
+import time
 from typing import Iterator
 
 import jax
@@ -162,6 +163,13 @@ def run_train(cfg: Config) -> TrainState:
     # the just-dispatched step and defeat async-dispatch pipelining
     step = int(state.step)
     guard = PreemptionGuard()
+    # periodic in-training eval, the train_and_evaluate cadence (ps:510-520):
+    # no eval before start_delay, then at most one per throttle interval.
+    # 0/0 (default) means end-of-training eval only — the reference's values
+    # (1000/1200) are config away (run.eval_start_delay_secs/throttle_secs)
+    eval_enabled = bool(cfg.data.val_data_dir) and cfg.run.eval_throttle_secs > 0
+    t_start = time.time()
+    next_eval = t_start + max(cfg.run.eval_start_delay_secs, cfg.run.eval_throttle_secs)
     with profile_cm, guard, _train_batches(cfg, ctx, skip_batches=step) as batches:
         for batch in batches:
             batch_size = int(batch["label"].shape[0])
@@ -171,6 +179,9 @@ def run_train(cfg: Config) -> TrainState:
                                         if k != "loss_per_shard"})
             if cfg.run.checkpoint_every_steps and step % cfg.run.checkpoint_every_steps == 0:
                 ckpt.save(state)
+            if eval_enabled and time.time() >= next_eval:
+                run_eval(cfg, ctx, state, log)
+                next_eval = time.time() + cfg.run.eval_throttle_secs
             if guard.should_stop:
                 break
 

@@ -61,13 +61,46 @@ def make_loss_fn(cfg: Config, model, lookup_fn=None) -> Callable:
     return loss_fn
 
 
+# tables eligible for lazy updates: the CTR families gather fm_w (1-D, the
+# wide term — absent in dcnv2) and fm_v (2-D) exactly once via lookup_fn
+LAZY_TABLE_KEYS = ("fm_w", "fm_v")
+
+
+def _lazy_keys(params: Any) -> list[str]:
+    return [k for k in LAZY_TABLE_KEYS if k in params]
+
+
+def _check_lazy(cfg: Config, params: Any) -> bool:
+    if not cfg.optimizer.lazy_embedding_updates:
+        return False
+    if cfg.optimizer.name.lower() != "adam":
+        raise ValueError(
+            "lazy_embedding_updates supports the Adam optimizer only"
+        )
+    if not _lazy_keys(params):
+        raise ValueError(
+            f"lazy_embedding_updates needs at least one of {LAZY_TABLE_KEYS} "
+            f"(CTR model families); {cfg.model.model_name!r} has "
+            f"{sorted(params)}"
+        )
+    return True
+
+
 def create_train_state(cfg: Config, key: jax.Array | None = None) -> TrainState:
     key = jax.random.PRNGKey(cfg.run.seed) if key is None else key
     init_key, step_key = jax.random.split(key)
     model = get_model(cfg.model)
     params, model_state = model.init(init_key, cfg.model)
     tx = build_optimizer(cfg.optimizer, data_parallel_size=_dp_size(cfg))
-    opt_state = tx.init(params)
+    if _check_lazy(cfg, params):
+        from .lazy import init_lazy_state
+
+        keys = _lazy_keys(params)
+        rest = {k: v for k, v in params.items() if k not in keys}
+        tables = {k: params[k] for k in keys}
+        opt_state = (tx.init(rest), init_lazy_state(tables))
+    else:
+        opt_state = tx.init(params)
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,
@@ -90,6 +123,13 @@ def make_train_step(cfg: Config, lookup_fn=None) -> Callable:
     model = get_model(cfg.model)
     loss_fn = make_loss_fn(cfg, model, lookup_fn)
     tx = build_optimizer(cfg.optimizer, data_parallel_size=_dp_size(cfg))
+    if cfg.optimizer.lazy_embedding_updates:
+        if lookup_fn is not None:
+            raise ValueError(
+                "lazy_embedding_updates builds its own row lookup; custom "
+                "lookup_fn (sharded tables) is the SPMD dense path"
+            )
+        return _make_lazy_train_step(cfg, model, tx)
 
     def train_step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
         step_rng = jax.random.fold_in(state.rng, state.step)
@@ -110,6 +150,92 @@ def make_train_step(cfg: Config, lookup_fn=None) -> Callable:
                 params=new_params,
                 model_state=new_model_state,
                 opt_state=new_opt_state,
+                rng=state.rng,
+            ),
+            metrics,
+        )
+
+    return train_step
+
+
+def _make_lazy_train_step(cfg: Config, model, tx) -> Callable:
+    """Sparse-table variant of the train step (train/lazy.py).
+
+    The gradient is taken w.r.t. the *gathered rows* — the dense [V, K]
+    table gradient (and its scatter) never exists — and the tables update
+    via touched-rows-only lazy Adam.  The CE loss drops the dense table-L2
+    term (ps:275-279); its gradient ``l2·w`` is applied inside the lazy
+    update on touched rows instead (see train/lazy.py semantics notes)."""
+    from ..ops.embedding import dense_lookup
+    from .lazy import LazyAdamState, lazy_adam_update, shared_segments
+
+    lr = cfg.optimizer.learning_rate
+    if cfg.optimizer.scale_lr_by_data_parallel:
+        lr = lr * _dp_size(cfg)
+
+    def train_step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
+        step_rng = jax.random.fold_in(state.rng, state.step)
+        params = state.params
+        keys = _lazy_keys(params)
+        rest = {k: v for k, v in params.items() if k not in keys}
+        tables = {k: params[k] for k in keys}
+        ids = batch["feat_ids"].reshape(-1, cfg.model.field_size)
+        rows = {k: dense_lookup(tables[k], ids) for k in keys}
+
+        def loss_fn(rest, rows):
+            # row substitution: the CTR families gather fm_w (1-D) and fm_v
+            # (2-D) exactly once through lookup_fn, so ndim disambiguates
+            def row_lookup(table, _ids):
+                return rows["fm_w"] if table.ndim == 1 else rows["fm_v"]
+
+            logits, new_state = model.apply(
+                {**rest, **tables},
+                state.model_state,
+                batch["feat_ids"],
+                batch["feat_vals"],
+                cfg=cfg.model,
+                train=True,
+                rng=step_rng,
+                lookup_fn=row_lookup,
+            )
+            labels = batch["label"].reshape(-1).astype(jnp.float32)
+            return jnp.mean(sigmoid_cross_entropy(logits, labels)), (
+                logits,
+                new_state,
+            )
+
+        grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)
+        (loss, (logits, new_model_state)), (g_rest, g_rows) = grad_fn(
+            rest, rows
+        )
+        rest_opt, lazy_state = state.opt_state
+        updates, new_rest_opt = tx.update(g_rest, rest_opt, rest)
+        new_rest = optax.apply_updates(rest, updates)
+
+        # one sort shared by the tables (identical ids); clip to the smallest
+        # table (fm_v may carry aligned-window padding rows beyond fm_w)
+        min_rows = min(tables[k].shape[0] for k in keys)
+        flat_ids = jnp.clip(ids.reshape(-1), 0, min_rows - 1)
+        segs = shared_segments(flat_ids)
+        step1 = state.step + 1
+        new_tables, new_m, new_v = {}, {}, {}
+        for key in keys:
+            new_tables[key], new_m[key], new_v[key] = lazy_adam_update(
+                tables[key], lazy_state.m[key], lazy_state.v[key],
+                flat_ids, g_rows[key], step1, cfg.optimizer,
+                learning_rate=lr, l2_reg=cfg.model.l2_reg, segmented=segs,
+            )
+        metrics = {
+            "loss": loss,
+            "pred_mean": jnp.mean(jax.nn.sigmoid(logits)),
+            "label_mean": jnp.mean(batch["label"].astype(jnp.float32)),
+        }
+        return (
+            TrainState(
+                step=step1,
+                params={**new_rest, **new_tables},
+                model_state=new_model_state,
+                opt_state=(new_rest_opt, LazyAdamState(m=new_m, v=new_v)),
                 rng=state.rng,
             ),
             metrics,

@@ -142,3 +142,20 @@ def test_full_lifecycle_train_eval_export_infer(data_dir, tmp_path, capsys):
     # no te* files exist, so infer falls back to the va* set (64 records)
     assert len(probs) == 64
     assert all(0.0 <= p <= 1.0 for p in probs)
+
+
+def test_periodic_eval_cadence(data_dir, tmp_path, capsys):
+    """In-training eval fires on the throttle clock (ps:510-520 semantics)."""
+    rc = main(
+        _common_args(data_dir, tmp_path)
+        + ["--task_type", "train",
+           "--set", "run.eval_throttle_secs=1",
+           "--set", "run.eval_start_delay_secs=0",
+           "--set", "data.num_epochs=60"]
+    )
+    assert rc == 0
+    out_lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    evals = [l for l in out_lines if l["kind"] == "eval"]
+    # at least one periodic eval fired before the end-of-training eval
+    assert len(evals) >= 2
+    assert all(0.0 <= e["auc"] <= 1.0 for e in evals)

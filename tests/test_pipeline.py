@@ -197,3 +197,29 @@ def test_skip_batches_fast_forward(tmp_path, monkeypatch, force_python):
             np.testing.assert_array_equal(got["label"], want["label"])
     assert run(6) == []   # completed job reruns as a no-op
     assert run(99) == []  # over-skip is safe
+
+
+@pytest.mark.parametrize("force_python", [False, True])
+def test_skip_batches_keep_remainder(tmp_path, monkeypatch, force_python):
+    """With drop_remainder=False the partial tail is a step; a skip ending
+    mid-tail must consume it, keeping resume aligned across epochs."""
+    if force_python:
+        monkeypatch.setenv("DEEPFM_NO_NATIVE", "1")
+    _write(tmp_path, "tr-0.tfrecords", 20, seed=4)  # per epoch: 8, 8, 4
+    cfg = DataConfig(batch_size=8, num_epochs=2, shuffle_files=False,
+                     drop_remainder=False)
+    topo = WorkerTopology(1, 0, 1, 0)
+
+    def run(skip):
+        return list(make_input_pipeline(
+            cfg, topo, field_size=FIELD, data_dir=str(tmp_path),
+            skip_batches=skip,
+        ))
+
+    full = run(0)
+    assert [b["label"].shape[0] for b in full] == [8, 8, 4, 8, 8, 4]
+    for skip in (2, 3, 4):  # 3 ends exactly at the tail, 4 crosses epochs
+        resumed = run(skip)
+        assert len(resumed) == 6 - skip
+        for got, want in zip(resumed, full[skip:]):
+            np.testing.assert_array_equal(got["feat_ids"], want["feat_ids"])
