@@ -58,8 +58,26 @@ class SPMDContext(NamedTuple):
     batch_shardings: Any
 
 
-def padded_vocab(feature_size: int, model_parallel: int) -> int:
-    return -(-feature_size // model_parallel) * model_parallel
+def padded_vocab(
+    feature_size: int, model_parallel: int, window_multiple: int = 1
+) -> int:
+    """Next vocab size divisible by the row-shard factor AND the Pallas
+    aligned-window multiple.  Using the lcm keeps init_deepfm's own window
+    padding at zero, so table shapes equal the padded vocab and the
+    path-based sharding rules (shape[0] == vocab) always match."""
+    import math
+
+    m = math.lcm(max(1, model_parallel), max(1, window_multiple))
+    return -(-feature_size // m) * m
+
+
+def _window_multiple(cfg: Config) -> int:
+    """init_deepfm pads fm_v to a 128-lane window multiple when the fused
+    kernel is enabled (models/deepfm.py) — mirror that here."""
+    k = cfg.model.embedding_size
+    if cfg.model.fused_kernel != "off" and 128 % k == 0:
+        return 128 // k
+    return 1
 
 
 def _spec_for_leaf(path, shape: tuple[int, ...], vocab: int) -> P:
@@ -76,16 +94,12 @@ def _spec_for_leaf(path, shape: tuple[int, ...], vocab: int) -> P:
 
 def _build_full_init(cfg: Config, true_vocab: int) -> Callable:
     """Initializer for the full TrainState with zeroed pad rows."""
-    if cfg.optimizer.lazy_embedding_updates:
-        raise NotImplementedError(
-            "lazy_embedding_updates runs on the single-controller path "
-            "(deepfm_tpu.train.create_train_state/make_train_step) only; "
-            "the SPMD path row-shards tables and uses dense updates"
-        )
     model = get_model(cfg.model)
     tx = build_optimizer(cfg.optimizer, data_parallel_size=cfg.mesh.data_parallel)
 
     def init_fn(key: jax.Array) -> TrainState:
+        from ..train.step import init_opt_state
+
         init_key, step_key = jax.random.split(key)
         params, model_state = model.init(init_key, cfg.model)
         for k in TABLE_KEYS:
@@ -98,7 +112,7 @@ def _build_full_init(cfg: Config, true_vocab: int) -> Callable:
             step=jnp.zeros((), jnp.int32),
             params=params,
             model_state=model_state,
-            opt_state=tx.init(params),
+            opt_state=init_opt_state(cfg, params, tx),
             rng=step_key,
         )
 
@@ -110,7 +124,7 @@ def make_context(cfg: Config, mesh: Mesh) -> SPMDContext:
     no parameter materialization (the 100M-vocab table never touches a host)."""
     dp, mp = mesh_shape(mesh)
     true_vocab = cfg.model.feature_size
-    pv = padded_vocab(true_vocab, mp)
+    pv = padded_vocab(true_vocab, mp, _window_multiple(cfg))
     cfg = cfg.with_overrides(
         model={"feature_size": pv},
         mesh={"data_parallel": dp, "model_parallel": mp},
@@ -206,6 +220,8 @@ def make_spmd_train_step(ctx: SPMDContext, *, donate: bool = True) -> Callable:
     cfg = ctx.cfg
     model = get_model(cfg.model)
     tx = build_optimizer(cfg.optimizer, data_parallel_size=cfg.mesh.data_parallel)
+    if cfg.optimizer.lazy_embedding_updates:
+        return _make_lazy_spmd_train_step(ctx, model, tx, donate=donate)
 
     def local_step(state: TrainState, batch: dict):
         # distinct dropout mask per data shard, identical across model shards
@@ -254,6 +270,127 @@ def make_spmd_train_step(ctx: SPMDContext, *, donate: bool = True) -> Callable:
         in_specs=(ctx.state_specs, ctx.batch_specs),
         out_specs=(ctx.state_specs, metric_specs),
         check_vma=False,  # grads of psum-assembled lookups defeat replication checking
+    )
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def _make_lazy_spmd_train_step(
+    ctx: SPMDContext, model, tx, *, donate: bool
+) -> Callable:
+    """Sharded lazy-Adam train step (train/lazy.py, SPMD edition).
+
+    The gradient is taken w.r.t. the psum-ASSEMBLED rows, so no dense table
+    gradient (or its data-axis pmean — the dominant ICI cost at large vocab)
+    ever exists.  Instead the per-shard row grads are all-gathered over the
+    data axis (B·F·K floats, independent of vocab size), deduped once with a
+    global sort — identical on every shard — and each model shard applies
+    the updates falling in its row range.  The dense table-L2 term moves
+    into the update (once per unique touched row; see train/lazy.py)."""
+    from ..train.lazy import lazy_adam_update_shard, shared_segments
+    from ..train.step import LAZY_TABLE_KEYS
+
+    cfg = ctx.cfg
+    lr = cfg.optimizer.learning_rate
+    if cfg.optimizer.scale_lr_by_data_parallel:
+        lr = lr * cfg.mesh.data_parallel
+    from ..parallel.embedding import sharded_lookup
+
+    def local_step(state: TrainState, batch: dict):
+        from ..train.lazy import LazyAdamState
+
+        step_rng = jax.random.fold_in(state.rng, state.step)
+        step_rng = jax.random.fold_in(step_rng, lax.axis_index(DATA_AXIS))
+        params = state.params
+        keys = [k for k in LAZY_TABLE_KEYS if k in params]
+        rest = {k: v for k, v in params.items() if k not in keys}
+        tables = {k: params[k] for k in keys}          # local row shards
+        ids2d = batch["feat_ids"].reshape(-1, cfg.model.field_size)
+        rows = {k: sharded_lookup(tables[k], ids2d) for k in keys}
+
+        def loss_fn(rest, rows):
+            def row_lookup(table, _ids):
+                return rows["fm_w"] if table.ndim == 1 else rows["fm_v"]
+
+            logits, new_state = model.apply(
+                {**rest, **tables},
+                state.model_state,
+                batch["feat_ids"],
+                batch["feat_vals"],
+                cfg=cfg.model,
+                train=True,
+                rng=step_rng,
+                lookup_fn=row_lookup,
+            )
+            labels = batch["label"].reshape(-1).astype(jnp.float32)
+            ce = jnp.mean(sigmoid_cross_entropy(logits, labels))
+            return ce, (logits, new_state)
+
+        (loss, (logits, new_model_state)), (g_rest, g_rows) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True
+        )(rest, rows)
+        g_rest = _pmean_grads(g_rest)
+        rest_opt, lazy_state = state.opt_state
+        updates, new_rest_opt = tx.update(g_rest, rest_opt, rest)
+        new_rest = optax.apply_updates(rest, updates)
+
+        # global id stream: all-gather over the data axis (replicated over
+        # the model axis).  Global loss = mean of shard means -> 1/dp scale.
+        # One sort/segment structure shared by the tables (identical ids).
+        dp = lax.psum(1, DATA_AXIS)
+        flat_local = ids2d.reshape(-1)
+        flat_ids = lax.all_gather(flat_local, DATA_AXIS, tiled=True)
+        flat_ids = jnp.clip(
+            flat_ids, 0,
+            min(tables[k].shape[0] for k in keys) * lax.psum(1, MODEL_AXIS) - 1,
+        )
+        order, seg, row_id, valid = shared_segments(flat_ids)
+        step1 = state.step + 1
+        new_tables, new_m, new_v = {}, {}, {}
+        for k in keys:
+            g = lax.all_gather(
+                g_rows[k].reshape(flat_local.shape[0], -1),
+                DATA_AXIS, tiled=True,
+            ) / dp
+            gsum = jax.ops.segment_sum(
+                g[order], seg, num_segments=flat_ids.shape[0],
+                indices_are_sorted=True,
+            )
+            new_tables[k], new_m[k], new_v[k] = lazy_adam_update_shard(
+                tables[k], lazy_state.m[k], lazy_state.v[k],
+                row_id, gsum, valid,
+                lax.axis_index(MODEL_AXIS) * tables[k].shape[0],
+                step1, cfg.optimizer,
+                learning_rate=lr, l2_reg=cfg.model.l2_reg,
+            )
+        metrics = {
+            "loss": lax.pmean(loss, DATA_AXIS),
+            "pred_mean": lax.pmean(jnp.mean(jax.nn.sigmoid(logits)), DATA_AXIS),
+            "label_mean": lax.pmean(
+                jnp.mean(batch["label"].astype(jnp.float32)), DATA_AXIS
+            ),
+            "loss_per_shard": loss[None],
+        }
+        new_state = TrainState(
+            step=step1,
+            params={**new_rest, **new_tables},
+            model_state=new_model_state,
+            opt_state=(new_rest_opt, LazyAdamState(m=new_m, v=new_v)),
+            rng=state.rng,
+        )
+        return new_state, metrics
+
+    metric_specs = {
+        "loss": P(),
+        "pred_mean": P(),
+        "label_mean": P(),
+        "loss_per_shard": P(DATA_AXIS),
+    }
+    mapped = shard_map(
+        local_step,
+        mesh=ctx.mesh,
+        in_specs=(ctx.state_specs, ctx.batch_specs),
+        out_specs=(ctx.state_specs, metric_specs),
+        check_vma=False,
     )
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 

@@ -125,6 +125,68 @@ def lazy_adam_update(
     return new_t.reshape(shape), new_m.reshape(shape), new_v.reshape(shape)
 
 
+def lazy_adam_update_shard(
+    local_table: jnp.ndarray,
+    m: jnp.ndarray,
+    v: jnp.ndarray,
+    row_id: jnp.ndarray,
+    gsum: jnp.ndarray,
+    valid: jnp.ndarray,
+    row_offset: jnp.ndarray,
+    step: jnp.ndarray,
+    cfg: OptimizerConfig,
+    *,
+    learning_rate: float,
+    l2_reg: float = 0.0,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Shard-local lazy Adam: apply pre-deduped global row updates to the
+    rows this shard owns ([row_offset, row_offset + local_rows)).
+
+    ``row_id``/``gsum``/``valid`` come from :func:`segment_rows` (or
+    :func:`shared_segments` + segment_sum) over the GLOBAL id stream —
+    identical on every shard — so replicas of the segment computation fold
+    into one XLA program and only the scatter targets differ per shard.
+    Out-of-range rows are dropped via out-of-bounds scatter indices.
+    """
+    shape = local_table.shape
+    width = 1
+    for d in shape[1:]:
+        width *= d
+    rows = shape[0]
+    t2 = local_table.reshape(rows, width)
+    m2 = m.reshape(rows, width)
+    v2 = v.reshape(rows, width)
+    g2 = gsum.reshape(row_id.shape[0], width)
+
+    local_id = row_id - row_offset
+    in_range = valid & (local_id >= 0) & (local_id < rows)
+    safe = jnp.clip(local_id, 0, rows - 1)
+    p_r = t2[safe]
+    if l2_reg:
+        g2 = g2 + l2_reg * p_r
+    m_r = m2[safe]
+    v_r = v2[safe]
+    b1, b2, eps = cfg.adam_b1, cfg.adam_b2, cfg.adam_eps
+    m_n = b1 * m_r + (1.0 - b1) * g2
+    v_n = b2 * v_r + (1.0 - b2) * jnp.square(g2)
+    t = step.astype(jnp.float32)
+    m_hat = m_n / (1.0 - jnp.power(b1, t))
+    v_hat = v_n / (1.0 - jnp.power(b2, t))
+    p_n = p_r - learning_rate * m_hat / (jnp.sqrt(v_hat) + eps)
+
+    n = row_id.shape[0]
+    scatter_id = jnp.where(
+        in_range, local_id, rows + jnp.arange(n, dtype=local_id.dtype)
+    )
+    # out-of-range rows interleave, so sortedness is NOT preservable here;
+    # uniqueness is (padding ids are distinct and >= rows)
+    kw = dict(unique_indices=True, mode="drop")
+    new_t = t2.at[scatter_id].add(p_n - p_r, **kw)
+    new_m = m2.at[scatter_id].add(m_n - m_r, **kw)
+    new_v = v2.at[scatter_id].add(v_n - v_r, **kw)
+    return new_t.reshape(shape), new_m.reshape(shape), new_v.reshape(shape)
+
+
 def shared_segments(flat_ids: jnp.ndarray):
     """Precompute the sort/segment structure once for tables sharing ids."""
     n = flat_ids.shape[0]

@@ -86,21 +86,28 @@ def _check_lazy(cfg: Config, params: Any) -> bool:
     return True
 
 
-def create_train_state(cfg: Config, key: jax.Array | None = None) -> TrainState:
-    key = jax.random.PRNGKey(cfg.run.seed) if key is None else key
-    init_key, step_key = jax.random.split(key)
-    model = get_model(cfg.model)
-    params, model_state = model.init(init_key, cfg.model)
-    tx = build_optimizer(cfg.optimizer, data_parallel_size=_dp_size(cfg))
+def init_opt_state(cfg: Config, params: Any, tx) -> Any:
+    """Optimizer state for ``params``: plain ``tx.init`` normally, or the
+    ``(rest_opt, LazyAdamState)`` pair when lazy embedding updates are on.
+    The single source of truth for the lazy state layout — the SPMD init
+    (parallel/spmd.py) calls this too, so checkpoints stay interchangeable."""
     if _check_lazy(cfg, params):
         from .lazy import init_lazy_state
 
         keys = _lazy_keys(params)
         rest = {k: v for k, v in params.items() if k not in keys}
         tables = {k: params[k] for k in keys}
-        opt_state = (tx.init(rest), init_lazy_state(tables))
-    else:
-        opt_state = tx.init(params)
+        return (tx.init(rest), init_lazy_state(tables))
+    return tx.init(params)
+
+
+def create_train_state(cfg: Config, key: jax.Array | None = None) -> TrainState:
+    key = jax.random.PRNGKey(cfg.run.seed) if key is None else key
+    init_key, step_key = jax.random.split(key)
+    model = get_model(cfg.model)
+    params, model_state = model.init(init_key, cfg.model)
+    tx = build_optimizer(cfg.optimizer, data_parallel_size=_dp_size(cfg))
+    opt_state = init_opt_state(cfg, params, tx)
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,
