@@ -32,28 +32,46 @@ class Checkpointer:
         directory: str | os.PathLike,
         *,
         max_to_keep: int = 3,
+        async_save: bool = True,
     ):
         self._mngr = ocp.CheckpointManager(
             os.path.abspath(directory),
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 create=True,
-                enable_async_checkpointing=False,
+                # async: the device->host copy completes before save()
+                # returns (so donated train-state buffers are safe to reuse
+                # immediately); only the file serialization runs in the
+                # background, overlapped with subsequent train steps.  At
+                # north-star table sizes a blocking save would stall training
+                # for the full write.
+                enable_async_checkpointing=async_save,
             ),
         )
 
-    def save(self, state: TrainState) -> bool:
+    def save(self, state: TrainState, *, block: bool = False) -> bool:
         """Save at ``state.step``.  Cadence is the CALLER's policy (the train
         loop's ``step % checkpoint_every_steps`` gate) — this class holds no
         interval logic.  A step already on disk is a no-op (so a final save
         after a periodic save at the same step is safe); returns whether a
-        save happened."""
+        save happened.
+
+        Async semantics: each save first barriers on any in-flight previous
+        save (``wait_until_finished`` at the next save point), then kicks off
+        the new one and returns as soon as the device->host copy is done.
+        ``block=True`` additionally waits for the write to hit disk."""
+        self._mngr.wait_until_finished()
         step = int(state.step)
         if step in self._mngr.all_steps():
             return False
         saved = self._mngr.save(step, args=ocp.args.StandardSave(state), force=True)
-        self._mngr.wait_until_finished()
+        if block:
+            self._mngr.wait_until_finished()
         return bool(saved)
+
+    def wait_until_finished(self) -> None:
+        """Barrier on any in-flight async save."""
+        self._mngr.wait_until_finished()
 
     def latest_step(self) -> int | None:
         return self._mngr.latest_step()
@@ -61,6 +79,7 @@ class Checkpointer:
     def restore(self, target_state: TrainState, step: int | None = None) -> TrainState:
         """Restore into the shardings/dtypes of ``target_state`` (an existing
         or abstract TrainState from the running mesh)."""
+        self._mngr.wait_until_finished()  # an in-flight save may be `step`
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError("no checkpoint to restore")
@@ -70,12 +89,30 @@ class Checkpointer:
             else x,
             target_state,
         )
-        return self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
+        try:
+            return self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
+        except Exception as e:
+            if "fm_v" in str(e) and (
+                "shape" in str(e).lower() or "Sizes" in str(e)
+            ):
+                raise RuntimeError(
+                    f"checkpoint restore failed on a shape mismatch involving "
+                    f"fm_v: {e}\nHint: checkpoints written with "
+                    f"model.fused_kernel != 'off' store a window-padded fm_v "
+                    f"(rows rounded up to a multiple of 128 // embedding_size "
+                    f"when feature_size doesn't divide it); restoring under a "
+                    f"different fused_kernel setting changes the expected "
+                    f"shape.  Restore with the same fused_kernel value the "
+                    f"checkpoint was trained with (docs/PARITY.md)."
+                ) from e
+            raise
 
     def all_steps(self) -> list[int]:
+        self._mngr.wait_until_finished()
         return list(self._mngr.all_steps())
 
     def close(self) -> None:
+        self._mngr.wait_until_finished()
         self._mngr.close()
 
 

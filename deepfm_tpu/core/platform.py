@@ -26,12 +26,30 @@ def sanitize_backend() -> None:
         if requested:
             # effective even if jax was imported (and env read) earlier
             jax.config.update("jax_platforms", requested)
-            # the tunnel plugin hooks jax's backend lookup, so the config
+            # The tunnel plugin hooks jax's backend lookup, so the config
             # update alone is insufficient — remove its factory whenever the
-            # explicit request does not name it
+            # explicit request does not name it.
+            # VERSION FRAGILITY: `jax._src.xla_bridge._backend_factories` is
+            # a private dict (present in jax 0.4.x–0.7.x; keyed by platform
+            # name).  If a jax upgrade renames it, the AttributeError lands
+            # in the except below and the tunnel backend stays registered —
+            # symptom: multi-minute hangs at first device attach despite
+            # JAX_PLATFORMS=cpu.
             from jax._src import xla_bridge as xb
 
             for p in _TUNNEL_PLATFORMS:
-                xb._backend_factories.pop(p, None)
-    except Exception:
-        pass  # never make startup worse than the status quo
+                if xb._backend_factories.pop(p, None) is not None:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "sanitize_backend: deregistered PJRT backend factory "
+                        "%r (JAX_PLATFORMS=%r does not include it)",
+                        p, requested,
+                    )
+    except Exception as e:  # never make startup worse than the status quo
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "sanitize_backend: could not deregister tunnel backends (%s); "
+            "device attach may hang if the tunnel is unreachable", e
+        )

@@ -140,6 +140,13 @@ def apply_deepfm(
     feat_ids = feat_ids.reshape(-1, cfg.field_size)
     feat_vals = feat_vals.reshape(-1, cfg.field_size).astype(jnp.float32)
 
+    if cfg.fused_kernel == "on" and lookup_fn is not dense_lookup:
+        raise ValueError(
+            "fused_kernel='on' requires the dense single-table lookup path; "
+            "lazy_embedding_updates and sharded (SPMD) tables substitute "
+            "their own row lookup, which cannot be fused — use "
+            "fused_kernel='auto' (or 'off') with those configs"
+        )
     use_fused = lookup_fn is dense_lookup and resolve_fused(cfg.fused_kernel)
     if use_fused and 128 % cfg.embedding_size != 0:
         if cfg.fused_kernel == "on":

@@ -2,11 +2,13 @@
 
 Two implementations:
 
-* ``ThresholdAUC`` — bucketed streaming AUC with trapezoidal interpolation,
-  semantics-compatible with ``tf.metrics.auc(num_thresholds=200)`` used for
-  the reference's eval metric (ps:282): fixed threshold grid with ±ε end
-  buckets, accumulated confusion counts, trapezoid ROC integration.  Used for
-  parity claims against the reference.
+* functional bucketed streaming AUC (``auc_init`` / ``auc_update`` /
+  ``auc_merge`` / ``auc_value`` over an ``AUCState``) with trapezoidal
+  interpolation, semantics-compatible with
+  ``tf.metrics.auc(num_thresholds=200)`` used for the reference's eval
+  metric (ps:282): fixed threshold grid with ±ε end buckets, accumulated
+  confusion counts, trapezoid ROC integration.  Used for parity claims
+  against the reference.
 * ``exact_auc`` — rank-based exact AUC (Mann-Whitney U) for a full prediction
   set; the quality oracle the bucketed metric is tested against.
 

@@ -208,7 +208,7 @@ def _local_loss(cfg: Config, model, params, model_state, batch, rng, train):
     labels = batch["label"].reshape(-1).astype(jnp.float32)
     ce = jnp.mean(sigmoid_cross_entropy(logits, labels))
     loss = ce + _sharded_penalty(params, cfg.model.l2_reg)
-    return loss, (logits, new_state)
+    return loss, (ce, logits, new_state)
 
 
 def make_spmd_train_step(ctx: SPMDContext, *, donate: bool = True) -> Callable:
@@ -233,7 +233,7 @@ def make_spmd_train_step(ctx: SPMDContext, *, donate: bool = True) -> Callable:
                 cfg, model, params, state.model_state, batch, step_rng, True
             )
 
-        (loss, (logits, new_model_state)), grads = jax.value_and_grad(
+        (loss, (ce, logits, new_model_state)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params)
         grads = _pmean_grads(grads)
@@ -241,6 +241,7 @@ def make_spmd_train_step(ctx: SPMDContext, *, donate: bool = True) -> Callable:
         new_params = optax.apply_updates(state.params, updates)
         metrics = {
             "loss": lax.pmean(loss, DATA_AXIS),
+            "ce": lax.pmean(ce, DATA_AXIS),
             "pred_mean": lax.pmean(jnp.mean(jax.nn.sigmoid(logits)), DATA_AXIS),
             "label_mean": lax.pmean(
                 jnp.mean(batch["label"].astype(jnp.float32)), DATA_AXIS
@@ -260,6 +261,7 @@ def make_spmd_train_step(ctx: SPMDContext, *, donate: bool = True) -> Callable:
 
     metric_specs = {
         "loss": P(),
+        "ce": P(),
         "pred_mean": P(),
         "label_mean": P(),
         "loss_per_shard": P(DATA_AXIS),
@@ -290,6 +292,7 @@ def _make_lazy_spmd_train_step(
     from ..train.step import LAZY_TABLE_KEYS
 
     cfg = ctx.cfg
+    true_vocab = ctx.true_feature_size
     lr = cfg.optimizer.learning_rate
     if cfg.optimizer.scale_lr_by_data_parallel:
         lr = lr * cfg.mesh.data_parallel
@@ -339,9 +342,19 @@ def _make_lazy_spmd_train_step(
         dp = lax.psum(1, DATA_AXIS)
         flat_local = ids2d.reshape(-1)
         flat_ids = lax.all_gather(flat_local, DATA_AXIS, tiled=True)
-        flat_ids = jnp.clip(
-            flat_ids, 0,
-            min(tables[k].shape[0] for k in keys) * lax.psum(1, MODEL_AXIS) - 1,
+        # Invalid ids must not train table rows: ids >= padded vocab
+        # contributed ZERO rows in the forward (sharded_lookup masks them),
+        # and ids in the padding gap [true_vocab, padded_vocab) would knock
+        # zero-init pad rows nonzero (breaking the pad-rows-stay-zero
+        # invariant init/restore rely on).  Remap both — and negatives — to
+        # the sentinel ``total_rows``, which falls outside every shard's
+        # [offset, offset+rows) window in lazy_adam_update_shard and is
+        # discarded there.
+        total_rows = min(tables[k].shape[0] for k in keys) * lax.psum(
+            1, MODEL_AXIS
+        )
+        flat_ids = jnp.where(
+            (flat_ids >= 0) & (flat_ids < true_vocab), flat_ids, total_rows
         )
         order, seg, row_id, valid = shared_segments(flat_ids)
         step1 = state.step + 1
@@ -363,7 +376,10 @@ def _make_lazy_spmd_train_step(
                 learning_rate=lr, l2_reg=cfg.model.l2_reg,
             )
         metrics = {
+            # CE only (table-L2 folds into the lazy update); 'ce' is the
+            # cross-path comparable quantity (docs/PARITY.md)
             "loss": lax.pmean(loss, DATA_AXIS),
+            "ce": lax.pmean(loss, DATA_AXIS),
             "pred_mean": lax.pmean(jnp.mean(jax.nn.sigmoid(logits)), DATA_AXIS),
             "label_mean": lax.pmean(
                 jnp.mean(batch["label"].astype(jnp.float32)), DATA_AXIS
@@ -381,6 +397,7 @@ def _make_lazy_spmd_train_step(
 
     metric_specs = {
         "loss": P(),
+        "ce": P(),
         "pred_mean": P(),
         "label_mean": P(),
         "loss_per_shard": P(DATA_AXIS),
@@ -409,7 +426,7 @@ def make_spmd_eval_step(ctx: SPMDContext) -> Callable:
     def local_eval(state: TrainState, auc_state: AUCState, batch: dict):
         weight = batch.get("weight")
         model_batch = {k: v for k, v in batch.items() if k != "weight"}
-        _, (logits, _) = _local_loss(
+        _, (_, logits, _) = _local_loss(
             cfg, model, state.params, state.model_state, model_batch, None, False
         )
         labels = batch["label"].reshape(-1).astype(jnp.float32)

@@ -38,7 +38,11 @@ def sigmoid_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarr
 
 def make_loss_fn(cfg: Config, model, lookup_fn=None) -> Callable:
     """loss = mean CE + the model family's L2 penalty (reference: ps:275-279
-    applies l2_reg·(½‖FM_W‖²+½‖FM_V‖²); each ModelDef declares its own)."""
+    applies l2_reg·(½‖FM_W‖²+½‖FM_V‖²); each ModelDef declares its own).
+
+    Aux carries the bare CE so every path (dense and lazy — whose 'loss' is
+    CE-only, the table L2 being folded into the lazy update) can log a
+    comparable ``ce`` metric; see docs/PARITY.md."""
     apply_fn, l2_penalty = model.apply, model.l2_penalty
 
     def loss_fn(params, model_state, batch, rng, train: bool):
@@ -56,7 +60,7 @@ def make_loss_fn(cfg: Config, model, lookup_fn=None) -> Callable:
         labels = batch["label"].reshape(-1).astype(jnp.float32)
         ce = jnp.mean(sigmoid_cross_entropy(logits, labels))
         loss = ce + l2_penalty(params, cfg.model.l2_reg)
-        return loss, (logits, new_state)
+        return loss, (ce, logits, new_state)
 
     return loss_fn
 
@@ -141,13 +145,14 @@ def make_train_step(cfg: Config, lookup_fn=None) -> Callable:
     def train_step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
         step_rng = jax.random.fold_in(state.rng, state.step)
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-        (loss, (logits, new_model_state)), grads = grad_fn(
+        (loss, (ce, logits, new_model_state)), grads = grad_fn(
             state.params, state.model_state, batch, step_rng, True
         )
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {
             "loss": loss,
+            "ce": ce,
             "pred_mean": jnp.mean(jax.nn.sigmoid(logits)),
             "label_mean": jnp.mean(batch["label"].astype(jnp.float32)),
         }
@@ -233,7 +238,11 @@ def _make_lazy_train_step(cfg: Config, model, tx) -> Callable:
                 learning_rate=lr, l2_reg=cfg.model.l2_reg, segmented=segs,
             )
         metrics = {
+            # CE only: the table-L2 gradient is folded into the lazy update,
+            # so no dense penalty term exists here; 'ce' is the cross-path
+            # comparable quantity (docs/PARITY.md)
             "loss": loss,
+            "ce": loss,
             "pred_mean": jnp.mean(jax.nn.sigmoid(logits)),
             "label_mean": jnp.mean(batch["label"].astype(jnp.float32)),
         }
@@ -260,7 +269,7 @@ def make_eval_step(cfg: Config, lookup_fn=None) -> Callable:
     def eval_step(
         state: TrainState, auc_state: AUCState, batch: dict
     ) -> tuple[AUCState, dict]:
-        loss, (logits, _) = loss_fn(
+        loss, (_, logits, _) = loss_fn(
             state.params, state.model_state, batch, None, False
         )
         preds = jax.nn.sigmoid(logits)

@@ -248,3 +248,34 @@ def test_write_predictions(tmp_path):
     assert n == 3
     lines = path.read_text().splitlines()
     assert lines == ["0.125000", "0.500000", "0.875000"]
+
+
+def test_async_checkpoint_overlaps_training(tmp_path):
+    """Async saves: save() returns after the device->host copy; training
+    continues (donation-safe) while the write is in flight; the barrier at
+    the next save point / restore / close makes the state durable and
+    restore returns exactly the saved values."""
+    state = create_train_state(CFG)
+    step_fn = jax.jit(make_train_step(CFG))
+    ck = Checkpointer(tmp_path / "ckpt", async_save=True)
+    for i in range(2):
+        state, _ = step_fn(state, _batch(jax.random.PRNGKey(i)))
+    assert ck.save(state)           # async kick-off
+    saved_fm_v = np.asarray(jax.device_get(state.params["fm_v"]))
+    # keep training while the write is (possibly) still in flight
+    for i in range(2, 5):
+        state, _ = step_fn(state, _batch(jax.random.PRNGKey(i)))
+    assert int(state.step) == 5
+    ck.wait_until_finished()
+    assert ck.latest_step() == 2
+    restored = ck.restore(create_train_state(CFG))
+    assert int(restored.step) == 2
+    np.testing.assert_allclose(
+        np.asarray(restored.params["fm_v"]), saved_fm_v, rtol=1e-6
+    )
+    # second async save barriers on the first and lands too
+    assert ck.save(state)
+    ck.close()
+    ck2 = Checkpointer(tmp_path / "ckpt")
+    assert ck2.latest_step() == 5
+    ck2.close()

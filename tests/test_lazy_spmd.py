@@ -147,3 +147,43 @@ def test_fused_window_padding_keeps_tables_sharded(lazy):
     batch = _batches(1)[0]
     state, m = step(state, shard_batch(ctx, batch))
     assert np.isfinite(float(m["loss"]))
+
+
+def test_lazy_spmd_oob_ids_dropped():
+    """Invalid ids must not train rows: ids >= padded vocab contributed ZERO
+    rows in the forward (sharded_lookup masks them), and ids in the padding
+    gap [true_vocab, padded_vocab) must not knock zero-init pad rows nonzero
+    — neither may scatter-apply a gradient anywhere."""
+    cfg = _cfg()
+    mesh = build_mesh(MeshConfig(data_parallel=2, model_parallel=4))
+    ctx = make_context(cfg, mesh)
+    state = create_spmd_state(ctx)
+    step = make_spmd_train_step(ctx, donate=False)
+    pv = ctx.cfg.model.feature_size
+    assert pv > V  # mesh padding present: the gap [V, pv) exists
+    batch = _batches(1)[0]
+    batch["feat_ids"] = batch["feat_ids"].copy()
+    batch["feat_ids"][:, -1] = pv + 3           # beyond the padded table
+    batch["feat_ids"][:, -2] = V + 1            # inside the padding gap
+    assert (pv - 1) not in batch["feat_ids"]    # ids % 11 << pv
+    before = np.asarray(jax.device_get(state.params["fm_v"]))
+    state, m = step(state, shard_batch(ctx, batch, validate_ids=False))
+    after = np.asarray(jax.device_get(state.params["fm_v"]))
+    assert np.isfinite(float(m["loss"]))
+    # the last row must be untouched by the beyond-table ids' gradients
+    np.testing.assert_array_equal(before[pv - 1], after[pv - 1])
+    # pad rows stay exactly zero (the init/restore invariant)
+    np.testing.assert_array_equal(after[V:], np.zeros_like(after[V:]))
+    # in-range ids still train
+    touched = np.unique(batch["feat_ids"][:, :-2].reshape(-1))
+    assert np.abs(after[touched] - before[touched]).max() > 0
+
+
+def test_fused_on_with_lazy_lookup_raises():
+    """fused_kernel='on' cannot be honored when lazy updates substitute their
+    own row lookup — fail loudly instead of silently running the XLA path."""
+    cfg = _cfg().with_overrides(model={"fused_kernel": "on"})
+    state = create_train_state(cfg)
+    step = make_train_step(cfg)
+    with pytest.raises(ValueError, match="fused_kernel='on'"):
+        step(state, _batches(1)[0])
