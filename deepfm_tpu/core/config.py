@@ -124,6 +124,9 @@ class DataConfig:
     multi_path: bool = False          # one stream path per local worker
     training_channel_name: str = "training"
     evaluation_channel_name: str = "evaluation"
+    # stream-mode eval reads the evaluation channel until EOF, or until this
+    # many batches when > 0 (a live channel may never close — bound the read)
+    eval_max_batches: int = 0
     prefetch_batches: int = 2         # double-buffered host->device feed
     file_patterns: tuple[str, ...] = ("tr", "train")
     # spread Zipf-hot ids across embedding shards with a fixed bijective

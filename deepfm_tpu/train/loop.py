@@ -97,7 +97,56 @@ def _padded_batches(
         yield batch, b
 
 
+def _eval_channel_path(cfg: Config) -> str:
+    """Stream-mode evaluation channel FIFO: ``<dir>/<evaluation_channel>``
+    (the reference reads eval data from the 'evaluation' channel in pipe
+    mode, hvd:420-424, README.md:81)."""
+    base = cfg.data.val_data_dir or cfg.data.training_data_dir
+    return os.path.join(base, cfg.data.evaluation_channel_name)
+
+
+def _has_eval_source(cfg: Config) -> bool:
+    if cfg.data.stream_mode:
+        return os.path.exists(_eval_channel_path(cfg))
+    return bool(cfg.data.val_data_dir)
+
+
 def _eval_dataset(cfg: Config, ctx: SPMDContext) -> InMemoryDataset:
+    permute = ctx.true_feature_size if cfg.data.permute_ids else 0
+    if cfg.data.stream_mode:
+        # bounded channel read: until the writer closes the FIFO (EOF), or
+        # eval_max_batches when set (a live channel may never close).  Each
+        # eval pass opens the channel anew — the feeder re-fills it per eval,
+        # mirroring pipe-mode's one-FIFO-per-pass semantics.
+        from ..data.pipeline import ctr_batches_from_sources
+
+        fifo = _eval_channel_path(cfg)
+        if not os.path.exists(fifo):
+            raise FileNotFoundError(
+                f"stream_mode eval needs the evaluation channel at {fifo!r} "
+                f"(data.evaluation_channel_name)"
+            )
+        batches = ctr_batches_from_sources(
+            [fifo],
+            batch_size=cfg.data.batch_size,
+            field_size=cfg.model.field_size,
+            drop_remainder=False,
+            permute_vocab=permute,
+        )
+        if cfg.data.eval_max_batches > 0:
+            batches = itertools.islice(batches, cfg.data.eval_max_batches)
+        collected = list(batches)
+        if not collected:
+            return InMemoryDataset(
+                np.zeros((0, cfg.model.field_size), np.int64),
+                np.zeros((0, cfg.model.field_size), np.float32),
+                np.zeros((0,), np.float32),
+            )
+        return InMemoryDataset(
+            np.concatenate([b["feat_ids"] for b in collected]),
+            np.concatenate([b["feat_vals"] for b in collected]),
+            np.concatenate([b["label"] for b in collected]),
+        )
     files = discover_files(
         cfg.data.val_data_dir or cfg.data.training_data_dir,
         patterns=("va", "val", "eval"),
@@ -108,8 +157,7 @@ def _eval_dataset(cfg: Config, ctx: SPMDContext) -> InMemoryDataset:
             f"no va*/val*/eval* tfrecords under {cfg.data.val_data_dir!r}"
         )
     return InMemoryDataset.from_files(
-        files, cfg.model.field_size,
-        permute_vocab=ctx.true_feature_size if cfg.data.permute_ids else 0,
+        files, cfg.model.field_size, permute_vocab=permute,
     )
 
 
@@ -167,7 +215,7 @@ def run_train(cfg: Config) -> TrainState:
     # no eval before start_delay, then at most one per throttle interval.
     # 0/0 (default) means end-of-training eval only — the reference's values
     # (1000/1200) are config away (run.eval_start_delay_secs/throttle_secs)
-    eval_enabled = bool(cfg.data.val_data_dir) and cfg.run.eval_throttle_secs > 0
+    eval_enabled = _has_eval_source(cfg) and cfg.run.eval_throttle_secs > 0
     t_start = time.time()
     next_eval = t_start + max(cfg.run.eval_start_delay_secs, cfg.run.eval_throttle_secs)
     with profile_cm, guard, _train_batches(cfg, ctx, skip_batches=step) as batches:
@@ -196,7 +244,7 @@ def run_train(cfg: Config) -> TrainState:
         log.event("preempted", step=step)
         ckpt.close()
         raise PreemptedError(f"preempted at step {step}")
-    if cfg.data.val_data_dir:
+    if _has_eval_source(cfg):
         run_eval(cfg, ctx, state, log)
     if cfg.run.servable_model_dir:
         # ctx.cfg, not cfg: the servable config must record the mesh-PADDED

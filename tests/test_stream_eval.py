@@ -1,0 +1,116 @@
+"""Stream-mode (pipe-mode) evaluation channel: the reference reads eval data
+from the 'evaluation' channel (hvd:420-424, README.md:81).  A pure-stream
+deployment must be able to train AND evaluate with no files on disk."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from deepfm_tpu.core.config import Config
+from deepfm_tpu.data.example_proto import serialize_ctr_example
+from deepfm_tpu.data.tfrecord import frame_record
+from deepfm_tpu.parallel import build_mesh, create_spmd_state, make_context
+from deepfm_tpu.train.loop import run_eval, run_train
+from deepfm_tpu.utils import MetricLogger
+
+FEATURE, FIELD = 64, 5
+
+
+def _records(n, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        ids = rng.integers(0, FEATURE, FIELD).tolist()
+        vals = rng.random(FIELD).astype(np.float32).tolist()
+        label = float(rng.random() < 0.3)
+        out.append(frame_record(serialize_ctr_example(label, ids, vals)))
+    return b"".join(out)
+
+
+def _cfg(tmp_path, **data):
+    return Config.from_dict(
+        {
+            "model": {
+                "feature_size": FEATURE,
+                "field_size": FIELD,
+                "embedding_size": 4,
+                "deep_layers": (8,),
+                "dropout_keep": (1.0,),
+                "compute_dtype": "float32",
+            },
+            "optimizer": {"learning_rate": 0.01},
+            "data": {
+                "batch_size": 8,
+                "stream_mode": True,
+                "training_data_dir": str(tmp_path),
+                **data,
+            },
+            "mesh": {"data_parallel": 4, "model_parallel": 2},
+            "run": {
+                "model_dir": str(tmp_path / "model"),
+                "servable_model_dir": "",
+                "checkpoint_every_steps": 0,
+                "log_steps": 100,
+            },
+        }
+    )
+
+
+def test_stream_mode_train_then_eval_channel(tmp_path, capsys):
+    """Full pure-stream lifecycle: train from the 'training' FIFO, then the
+    final eval reads the 'evaluation' FIFO to EOF — no files anywhere."""
+    train_fifo = tmp_path / "training"
+    eval_fifo = tmp_path / "evaluation"
+    os.mkfifo(train_fifo)
+    os.mkfifo(eval_fifo)
+
+    def feed(path, payload):
+        with open(path, "wb") as f:
+            f.write(payload)
+
+    t1 = threading.Thread(
+        target=feed, args=(train_fifo, _records(64, seed=1)), daemon=True
+    )
+    # open() on the eval FIFO blocks until run_eval opens the read side,
+    # so starting the feeder up-front is safe
+    t2 = threading.Thread(
+        target=feed, args=(eval_fifo, _records(24, seed=2)), daemon=True
+    )
+    t1.start()
+    t2.start()
+    state = run_train(_cfg(tmp_path))
+    t1.join(timeout=10)
+    t2.join(timeout=10)
+    assert int(state.step) == 64 // 8
+    events = [
+        json.loads(line)
+        for line in capsys.readouterr().out.splitlines()
+        if line.startswith("{")
+    ]
+    evals = [e for e in events if e.get("kind") == "eval"]
+    assert evals, f"no eval event in {events}"
+    assert evals[-1]["examples"] == 24
+    assert 0.0 <= evals[-1]["auc"] <= 1.0
+
+
+def test_stream_eval_bounded_read(tmp_path):
+    """eval_max_batches bounds the channel read (a live channel may never
+    close); works with a plain file standing in for the channel."""
+    cfg = _cfg(tmp_path, eval_max_batches=2)
+    with open(tmp_path / "evaluation", "wb") as f:
+        f.write(_records(40, seed=3))
+    ctx = make_context(cfg, build_mesh(cfg.mesh))
+    state = create_spmd_state(ctx)
+    result = run_eval(cfg, ctx, state, MetricLogger())
+    assert result["examples"] == 2 * cfg.data.batch_size
+
+
+def test_stream_eval_missing_channel_raises(tmp_path):
+    cfg = _cfg(tmp_path)
+    ctx = make_context(cfg, build_mesh(cfg.mesh))
+    state = create_spmd_state(ctx)
+    with pytest.raises(FileNotFoundError, match="evaluation"):
+        run_eval(cfg, ctx, state, MetricLogger())
