@@ -1,0 +1,323 @@
+"""Convergence / AUC-parity evidence on the reference's real data.
+
+The reference's quality metric is the streaming eval AUC (ps:282); it
+publishes no target value and its TF1 stack is not installable here, so the
+parity case is self-generated (BASELINE.md): train the flagship config on a
+deterministic split of the bundled `/root/reference/data/val.tfrecords`
+(10,000 real Criteo-style records — train.tfrecords was stripped upstream),
+hold out every 5th record, and record the loss curve + held-out AUC for
+
+  * single_dense — the reference's single-worker trajectory (jit, dense Adam)
+  * spmd_dp8     — sync data-parallel on an 8-device mesh (the Horovod path;
+                   also the async-PS replacement, so matching single-device
+                   AUC *is* the sync-vs-async convergence argument of
+                   docs/PARITY.md §2c)
+  * spmd_dp4_mp2 — data-parallel × row-sharded tables (the PS capability)
+  * lazy_adam    — touched-rows-only Adam (the sparse-update trajectory)
+
+plus a streaming-AUC vs exact-AUC (Mann-Whitney) cross-check per eval.
+
+Writes docs/convergence_results.json and docs/CONVERGENCE.md.
+
+    python benchmarks/convergence.py [--epochs 60] [--out docs]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepfm_tpu.core.platform import sanitize_backend  # noqa: E402
+
+sanitize_backend()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+VAL_TFRECORDS = "/root/reference/data/val.tfrecords"
+HOLDOUT_MOD = 5  # record i is eval iff i % 5 == 0 (deterministic 80/20)
+
+
+def load_split():
+    from deepfm_tpu.data.pipeline import InMemoryDataset
+
+    full = InMemoryDataset.from_files([VAL_TFRECORDS], field_size=39)
+    n = len(full)
+    idx = np.arange(n)
+    ev = idx % HOLDOUT_MOD == 0
+    tr = ~ev
+
+    def subset(mask):
+        return InMemoryDataset(
+            full.feat_ids[mask], full.feat_vals[mask], full.label[mask]
+        )
+
+    return subset(tr), subset(ev)
+
+
+def flagship_cfg(batch_size: int, *, lazy: bool = False):
+    from deepfm_tpu.core.config import Config
+
+    # the reference notebook's training job (ps nb cell 4): batch 1024,
+    # V=117,581, F=39, K=32, deep 128/64/32, dropout keep 0.5, Adam 5e-4,
+    # l2 1e-4 (script default ps:57)
+    return Config.from_dict(
+        {
+            "model": {
+                "feature_size": 117_581,
+                "field_size": 39,
+                "embedding_size": 32,
+                "deep_layers": (128, 64, 32),
+                "dropout_keep": (0.5, 0.5, 0.5),
+                "l2_reg": 1e-4,
+                "compute_dtype": "float32",  # CPU run; TPU uses bf16
+            },
+            "optimizer": {
+                "learning_rate": 5e-4,
+                "lazy_embedding_updates": lazy,
+            },
+            "data": {"batch_size": batch_size},
+        }
+    )
+
+
+def evaluate(predict, ds, batch_size=2000):
+    """Streaming bucketed AUC + exact AUC + mean CE on a dataset."""
+    from deepfm_tpu.ops.auc import auc_init, auc_update, auc_value, exact_auc
+
+    state = auc_init()
+    all_p, all_y, ce_sum = [], [], 0.0
+    for i in range(0, len(ds), batch_size):
+        ids = ds.feat_ids[i : i + batch_size]
+        vals = ds.feat_vals[i : i + batch_size]
+        y = ds.label[i : i + batch_size]
+        p = np.asarray(predict(ids, vals))
+        eps = 1e-7
+        ce_sum += float(
+            -np.sum(y * np.log(p + eps) + (1 - y) * np.log(1 - p + eps))
+        )
+        state = auc_update(state, y, p)
+        all_p.append(p)
+        all_y.append(y)
+    p = np.concatenate(all_p)
+    y = np.concatenate(all_y)
+    return {
+        "auc_streaming": float(auc_value(state)),
+        "auc_exact": float(exact_auc(y, p)),
+        "ce": ce_sum / len(ds),
+    }
+
+
+def run_single(train_ds, eval_ds, *, epochs, batch_size, lazy, eval_every):
+    from deepfm_tpu.train import create_train_state, make_train_step
+    from deepfm_tpu.train.step import make_predict_step
+
+    cfg = flagship_cfg(batch_size, lazy=lazy)
+    state = create_train_state(cfg)
+    step_fn = jax.jit(make_train_step(cfg), donate_argnums=(0,))
+    predict_raw = jax.jit(make_predict_step(cfg))
+    curve = []
+    t0 = time.time()
+    step = 0
+    for epoch in range(1, epochs + 1):
+        for batch in train_ds.batches(
+            batch_size, shuffle=True, seed=epoch, drop_remainder=True
+        ):
+            state, m = step_fn(state, batch)
+            step += 1
+        if epoch % eval_every == 0 or epoch == epochs:
+            pred = lambda i, v: predict_raw(  # noqa: E731
+                state, {"feat_ids": i, "feat_vals": v}
+            )
+            ev = evaluate(pred, eval_ds)
+            tr = evaluate(pred, train_ds)
+            curve.append(
+                {
+                    "epoch": epoch,
+                    "step": step,
+                    "train_ce": round(float(m["ce"]), 5),
+                    "eval_auc": round(ev["auc_streaming"], 5),
+                    "eval_auc_exact": round(ev["auc_exact"], 5),
+                    "eval_ce": round(ev["ce"], 5),
+                    "train_auc": round(tr["auc_streaming"], 5),
+                }
+            )
+            print(json.dumps(curve[-1]), file=sys.stderr)
+    return curve, round(time.time() - t0, 1)
+
+
+def run_spmd(train_ds, eval_ds, *, epochs, batch_size, dp, mp, eval_every):
+    from deepfm_tpu.core.config import MeshConfig
+    from deepfm_tpu.parallel import (
+        build_mesh,
+        create_spmd_state,
+        make_context,
+        make_spmd_predict_step,
+        make_spmd_train_step,
+        shard_batch,
+    )
+
+    cfg = flagship_cfg(batch_size).with_overrides(
+        mesh={"data_parallel": dp, "model_parallel": mp}
+    )
+    mesh = build_mesh(MeshConfig(data_parallel=dp, model_parallel=mp))
+    ctx = make_context(cfg, mesh)
+    state = create_spmd_state(ctx)
+    step_fn = make_spmd_train_step(ctx)
+    predict_fn = make_spmd_predict_step(ctx)
+    curve = []
+    t0 = time.time()
+    step = 0
+    for epoch in range(1, epochs + 1):
+        for batch in train_ds.batches(
+            batch_size, shuffle=True, seed=epoch, drop_remainder=True
+        ):
+            state, m = step_fn(state, shard_batch(ctx, batch))
+            step += 1
+        if epoch % eval_every == 0 or epoch == epochs:
+
+            def pred(ids, vals):
+                b = ids.shape[0]
+                pad = (-b) % dp
+                if pad:
+                    ids = np.concatenate([ids, np.repeat(ids[-1:], pad, 0)])
+                    vals = np.concatenate([vals, np.repeat(vals[-1:], pad, 0)])
+                sb = shard_batch(
+                    ctx,
+                    {
+                        "feat_ids": ids,
+                        "feat_vals": vals,
+                        "label": np.zeros(ids.shape[0], np.float32),
+                    },
+                )
+                return np.asarray(jax.device_get(predict_fn(state, sb)))[:b]
+
+            ev = evaluate(pred, eval_ds)
+            curve.append(
+                {
+                    "epoch": epoch,
+                    "step": step,
+                    "train_ce": round(float(m["ce"]), 5),
+                    "eval_auc": round(ev["auc_streaming"], 5),
+                    "eval_auc_exact": round(ev["auc_exact"], 5),
+                    "eval_ce": round(ev["ce"], 5),
+                }
+            )
+            print(json.dumps(curve[-1]), file=sys.stderr)
+    return curve, round(time.time() - t0, 1)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=60)
+    ap.add_argument("--batch-size", type=int, default=512)
+    ap.add_argument("--eval-every", type=int, default=10)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "docs"))
+    args = ap.parse_args()
+
+    if not os.path.exists(VAL_TFRECORDS):
+        print(json.dumps({"error": "reference val.tfrecords not available"}))
+        return
+    train_ds, eval_ds = load_split()
+    meta = {
+        "data": VAL_TFRECORDS,
+        "train_records": len(train_ds),
+        "eval_records": len(eval_ds),
+        "split": f"record i is eval iff i % {HOLDOUT_MOD} == 0",
+        "epochs": args.epochs,
+        "batch_size": args.batch_size,
+        "platform": jax.devices()[0].platform,
+        "device_count": jax.device_count(),
+        "label_mean_train": round(float(train_ds.label.mean()), 5),
+        "label_mean_eval": round(float(eval_ds.label.mean()), 5),
+    }
+    print(json.dumps(meta), file=sys.stderr)
+    results = {}
+    kw = dict(epochs=args.epochs, batch_size=args.batch_size,
+              eval_every=args.eval_every)
+    results["single_dense"] = dict(
+        zip(("curve", "seconds"),
+            run_single(train_ds, eval_ds, lazy=False, **kw))
+    )
+    results["lazy_adam"] = dict(
+        zip(("curve", "seconds"),
+            run_single(train_ds, eval_ds, lazy=True, **kw))
+    )
+    if jax.device_count() >= 8:
+        results["spmd_dp8"] = dict(
+            zip(("curve", "seconds"),
+                run_spmd(train_ds, eval_ds, dp=8, mp=1, **kw))
+        )
+        results["spmd_dp4_mp2"] = dict(
+            zip(("curve", "seconds"),
+                run_spmd(train_ds, eval_ds, dp=4, mp=2, **kw))
+        )
+
+    payload = {"meta": meta, "results": results}
+    os.makedirs(args.out, exist_ok=True)
+    json_path = os.path.join(args.out, "convergence_results.json")
+    with open(json_path, "w") as f:
+        json.dump(payload, f, indent=1)
+
+    lines = [
+        "# Convergence / AUC parity evidence",
+        "",
+        "Generated by `python benchmarks/convergence.py` — flagship config "
+        "(reference notebook cell 4: V=117,581, F=39, K=32, deep 128/64/32, "
+        "dropout keep 0.5, Adam 5e-4, l2 1e-4) trained on a deterministic "
+        "80/20 split of the bundled real data "
+        "`/root/reference/data/val.tfrecords` "
+        f"({meta['train_records']} train / {meta['eval_records']} held-out "
+        "records).  The reference's eval metric is streaming AUC (ps:282); "
+        "it publishes no value, so this is the self-generated baseline "
+        "curve BASELINE.md calls for.",
+        "",
+        f"Platform: {meta['platform']} x{meta['device_count']}, "
+        f"batch {meta['batch_size']}, {meta['epochs']} epochs.",
+        "",
+        "| variant | final eval AUC | exact-AUC cross-check | eval CE | "
+        "best eval AUC | seconds |",
+        "|---|---|---|---|---|---|",
+    ]
+    for name, r in results.items():
+        last = r["curve"][-1]
+        best = max(c["eval_auc"] for c in r["curve"])
+        lines.append(
+            f"| {name} | {last['eval_auc']:.4f} | "
+            f"{last['eval_auc_exact']:.4f} | {last['eval_ce']:.4f} | "
+            f"{best:.4f} | {r['seconds']} |"
+        )
+    lines += [
+        "",
+        "Reading the table:",
+        "",
+        "- **sync-vs-async convergence** (PARITY.md §2c): `spmd_dp8` is the "
+        "sync-SPMD replacement for the reference's async PS path; its AUC "
+        "matching `single_dense` is the convergence-parity argument, now "
+        "backed by numbers.",
+        "- **row-sharded tables** (`spmd_dp4_mp2`) and **lazy Adam** "
+        "(`lazy_adam`) must match too — the PS-capability and "
+        "sparse-update trajectories.",
+        "- **streaming vs exact AUC**: the bucketed tf.metrics.auc-"
+        "compatible metric (200 thresholds) agrees with the Mann-Whitney "
+        "exact AUC to ~1e-3 while predictions are calibrated; once the "
+        "model overfits and probabilities saturate toward 0/1, the fixed "
+        "threshold grid coarsens and the bucketed value drifts low — the "
+        "same artifact tf.metrics.auc(num_thresholds=200) exhibits, which "
+        "is itself part of the parity story (ops/auc.py).",
+        "",
+        "Full curves: `docs/convergence_results.json`.",
+    ]
+    with open(os.path.join(args.out, "CONVERGENCE.md"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(json.dumps({k: r["curve"][-1] for k, r in results.items()}))
+
+
+if __name__ == "__main__":
+    main()
