@@ -2,8 +2,8 @@
 
 The DeepFM hot op (reference ps:206-217) is two HBM table gathers followed
 by elementwise scaling and the FM reductions.  The bandwidth-dominant part —
-the FM_V [V, K] row gather — is hand-scheduled here as a deep DMA pipeline;
-the cheap parts (the [V] FM_W gather and the FM first/second-order
+the FM_V [V, K] row gather — is hand-scheduled here as a deduplicated DMA
+pipeline; the cheap parts (the [V] FM_W gather and the FM first/second-order
 reductions) stay in XLA, which fuses them into single VPU passes over the
 kernel's output.
 
@@ -14,9 +14,27 @@ the minor dimension must be 128-lane tiles), so the kernel works on an
     table  [V, K]  →  windows [V·K/128, 128]   (4 rows per window for K=32)
     row r lives in window r·K/128 at lane offset (r·K) mod 128
 
-    per row  : DMA one 128-lane window HBM→VMEM, NSEM copies in flight
-    per tile : epilogue selects the K-lane sub-window with static
-               pltpu.roll + masked select, then scales by vals (VPU)
+**Dedup-before-DMA** (v2 — fixes the round-1 skewed-id regression): ids are
+deduplicated in XLA first (one sort), and the kernel gathers each *unique*
+row exactly once, in sorted order:
+
+    XLA   : unique(ids)  →  sorted unique rows + inverse map
+    kernel: per unique row, DMA its 128-lane window HBM→VMEM — but only
+            when the window differs from the previous row's (sorted ids
+            put same-window rows adjacent), NSEM copies in flight
+    kernel: log-step forward-fill propagates each DMA'd window to the
+            following rows that share it, then a static-roll masked select
+            picks the K-lane sub-window per row (VPU)
+    XLA   : emb = unique_rows[inverse] * vals   (one dense gather + scale)
+
+On Zipf-skewed Criteo ids a batch of 1024×39 lookups hits only ~30-40% as
+many unique rows, and sorted adjacency packs ~`128/K` unique rows per
+window, so HBM traffic drops several-fold exactly where the round-1 kernel
+lost to XLA (hot windows were re-DMA'd per duplicate: ~240µs vs ~104µs per
+train step on v5e).  Uniform ids benefit from the window packing alone.
+The dedup's sort also pays for the backward: the custom VJP segment-sums
+row gradients by the same inverse map and scatter-adds each unique row
+once — no duplicate-index scatter serialization.
 
 Only the gathered working set sits in VMEM, so the kernel scales to
 vocabularies far beyond VMEM (the 100M-row north star) — the table stays in
@@ -24,21 +42,11 @@ HBM and is touched only near the gathered rows, exactly like the
 parameter-server pull the reference does over grpc (README.md:15,63), but at
 HBM-DMA latency instead of network latency.
 
-Backward is a custom VJP in plain XLA (gather + scatter-add): the backward
-of an embedding gather is a sparse scatter, which XLA already emits
-optimally, so only the bandwidth-bound forward is hand-scheduled.
-
-Measured on one v5e chip (batch 1024×39, V=117,581, K=32, full train step,
-see bench.py): at parity with the XLA gather path on uniform ids (~100µs vs
-~104µs/step) but ~2x slower on Zipf-skewed Criteo-like ids (~240µs), where
-the same hot window is re-DMA'd thousands of times per batch while XLA's
-native gather apparently exploits the duplicate locality.  Default is
-therefore ``fused_kernel="off"``; bench.py measures both paths and reports
-the faster, and "auto"/"on" opt in per run.
-
 Use ``fused_ctr_interaction`` (the custom-vjp wrapper).  On CPU the kernel
 runs in Pallas interpret mode — the same code path CI exercises
-deterministically (tests/test_pallas_ctr.py).
+deterministically (tests/test_pallas_ctr.py).  Default remains
+``fused_kernel="off"`` until the v2 numbers are recorded on real hardware
+(bench.py measures both paths and reports the faster).
 """
 
 from __future__ import annotations
@@ -52,153 +60,233 @@ from jax.experimental.pallas import tpu as pltpu
 
 _LANES = 128
 _N_TILE = 1024          # gathered rows per grid step
-_NSEM = 64             # DMA pipeline depth (copies in flight)
+_NSEM = 64              # DMA pipeline depth (copies in flight)
 
 
-def _gather_kernel(win_ref, sel_ref, vals_ref, table_ref, emb_ref, windows, sems):
-    """Gather one tile of rows as aligned 128-lane windows, then select+scale.
+def _dedup_plan(flat_ids: jnp.ndarray, per_win: int):
+    """XLA-side dedup: one sort over the flat id stream.
 
-    win_ref:   scalar-prefetch [N] int32 — window index per gathered row
-    sel_ref:   [N_TILE, 1] int32 VMEM — lane-offset selector (0..LANES/K-1)
-    vals_ref:  [N_TILE, 1] f32 VMEM — per-row scale (feature values)
-    table_ref: [V·K/LANES, LANES] f32 HBM — aligned-window view of FM_V
-    emb_ref:   out [N_TILE, K] f32 VMEM — scaled gathered rows
-    windows:   scratch [N_TILE, LANES] f32 VMEM
-    sems:      [NSEM] DMA semaphores
+    Returns (uids, inv, valid, win, sel, first, dist, dma_rows) where all
+    per-row arrays are padded to a ``_N_TILE`` multiple:
+
+      uids    [N]  sorted unique row ids (pad slots hold a repeated id)
+      inv     [n]  position of each original id in ``uids``
+      valid   [N]  True for real unique slots (False for padding)
+      win     [N]  window index per unique row
+      sel     [N]  lane-offset selector (0..per_win-1)
+      first   [N]  1 where the row's window differs from the previous row's
+                   (or at a tile boundary) — exactly the rows the kernel DMAs
+      dist    [N]  distance to the row's window source (for forward-fill)
+      dma_rows[N]  per tile, at flat index base+d: the row-in-tile of the
+                   d-th DMA — lets the kernel retire semaphores in order
+    """
+    n = flat_ids.shape[0]
+    uids, inv, counts = jnp.unique(
+        flat_ids, size=n, fill_value=0, return_inverse=True,
+        return_counts=True,
+    )
+    pad = (-n) % _N_TILE
+    total = n + pad
+    if pad:
+        uids = jnp.pad(uids, (0, pad), mode="edge")
+        counts = jnp.pad(counts, (0, pad))
+    valid = counts > 0
+    win = (uids // per_win).astype(jnp.int32)
+    sel = (uids % per_win).astype(jnp.int32)
+    j = jnp.arange(total, dtype=jnp.int32)
+    prev_win = jnp.concatenate([win[:1] - 1, win[:-1]])
+    first = ((j % _N_TILE == 0) | (win != prev_win)).astype(jnp.int32)
+    src = jax.lax.associative_scan(jnp.maximum, jnp.where(first == 1, j, -1))
+    dist = (j - src).astype(jnp.int32)
+    n_tiles = total // _N_TILE
+    ft = first.reshape(n_tiles, _N_TILE)
+    c = jnp.cumsum(ft, axis=1) - 1
+    rows = jnp.broadcast_to(
+        jnp.arange(_N_TILE, dtype=jnp.int32)[None], (n_tiles, _N_TILE)
+    )
+    dma_rows = (
+        jnp.zeros((n_tiles, _N_TILE), jnp.int32)
+        .at[jnp.arange(n_tiles)[:, None], jnp.where(ft == 1, c, _N_TILE)]
+        .set(rows, mode="drop")
+        .reshape(-1)
+    )
+    return uids, inv, valid, win, sel, first, dist, dma_rows
+
+
+def _gather_unique_kernel(
+    win_ref, first_ref, dma_rows_ref, sel_ref, dist_ref, table_ref, emb_ref,
+    windows, sems, *, per_win,
+):
+    """Gather one tile of SORTED unique rows, one DMA per distinct window.
+
+    win_ref/first_ref/dma_rows_ref: scalar-prefetch [N] int32 (see
+    ``_dedup_plan``); sel_ref/dist_ref: [N_TILE, 1] int32 VMEM;
+    table_ref: [V·K/LANES, LANES] f32 HBM (aligned-window view);
+    emb_ref: out [N_TILE, K] f32 VMEM; windows: scratch [N_TILE, LANES];
+    sems: [NSEM] DMA semaphores.
     """
     i = pl.program_id(0)
+    base = i * _N_TILE
     k = emb_ref.shape[1]
 
-    def dma(n):
+    def dma(row, d):
         return pltpu.make_async_copy(
-            table_ref.at[win_ref[i * _N_TILE + n]],   # (LANES,) aligned window
-            windows.at[n],
-            sems.at[n % _NSEM],
+            table_ref.at[win_ref[base + row]],   # (LANES,) aligned window
+            windows.at[row],
+            sems.at[d % _NSEM],
         )
 
-    def issue(n, _):
-        # retire the copy that used this semaphore slot NSEM steps ago,
-        # then reuse the slot — keeps NSEM copies in flight
-        @pl.when(n >= _NSEM)
+    def issue(j, cnt):
+        f = first_ref[base + j]
+
+        @pl.when(f == 1)
         def _():
-            dma(n - _NSEM).wait()
+            # retire the copy that used this semaphore slot NSEM DMAs ago,
+            # then reuse the slot — keeps up to NSEM copies in flight
+            @pl.when(cnt >= _NSEM)
+            def _():
+                dma(dma_rows_ref[base + cnt - _NSEM], cnt - _NSEM).wait()
 
-        dma(n).start()
+            dma(j, cnt).start()
+
+        return cnt + f
+
+    total = jax.lax.fori_loop(0, _N_TILE, issue, jnp.int32(0))
+
+    def drain(d, _):
+        dma(dma_rows_ref[base + d], d).wait()
         return ()
 
-    jax.lax.fori_loop(0, _N_TILE, issue, ())
+    jax.lax.fori_loop(jnp.maximum(total - _NSEM, 0), total, drain, ())
 
-    def drain(n, _):
-        dma(n).wait()
-        return ()
-
-    jax.lax.fori_loop(_N_TILE - _NSEM, _N_TILE, drain, ())
-
-    # epilogue (VPU): pick the K-lane sub-window per row, scale by vals.
-    # q is static per branch, so roll shifts are static; the dynamic lane
-    # offset is resolved by the masked select over LANES/K candidates.
+    # forward-fill: propagate each DMA'd window down to the rows sharing it.
+    # Sorted unique ids put same-window rows adjacent, so a real row's
+    # source is at most per_win-1 rows back — ceil(log2(per_win)) passes.
+    # At pass b, rows with dist in [2^b, 2^(b+1)) copy from a row whose own
+    # dist < 2^b, i.e. already resolved.  (Rows with j < shift would wrap,
+    # but their dist ≤ j < shift, so the mask never takes them.)
     w = windows[:]                                       # [N_TILE, LANES]
+    d = dist_ref[:]                                      # [N_TILE, 1]
+    for b in range(max(0, per_win - 1).bit_length()):
+        s = 1 << b
+        cand = pltpu.roll(w, shift=s, axis=0)
+        w = jnp.where((d >= s) & (d < 2 * s), cand, w)
+
+    # epilogue (VPU): pick the K-lane sub-window per row.  q is static per
+    # branch, so roll shifts are static; the dynamic lane offset is resolved
+    # by the masked select over LANES/K candidates.
     sel = sel_ref[:]                                     # [N_TILE, 1]
     e = jnp.zeros((_N_TILE, k), jnp.float32)
-    for q in range(_LANES // k):
+    for q in range(per_win):
         cand = pltpu.roll(w, shift=(-q * k) % _LANES, axis=1)[:, :k]
         e = jnp.where(sel == q, cand, e)
-    emb_ref[:] = e * vals_ref[:]
+    emb_ref[:] = e
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def _gather_scaled(fm_v, ids, vals, *, interpret: bool):
-    """Pallas path for ``scaled_embedding``: [V,K], [B,F], [B,F] -> [B,F,K]."""
-    batch, f_size = ids.shape
+def _gather_unique(fm_v, win, sel, first, dist, dma_rows, *, interpret: bool):
+    """Pallas gather of sorted unique rows: [V,K] + plan -> [N, K]."""
     v, k = fm_v.shape
     if _LANES % k:
         raise ValueError(f"embedding_size {k} must divide {_LANES}")
     per_win = _LANES // k
-    ids = jnp.clip(ids.astype(jnp.int32), 0, v - 1)
 
     # aligned-window view: pad rows to a window multiple, flatten, refold
     v_pad = (-v) % per_win
     table = fm_v if not v_pad else jnp.pad(fm_v, ((0, v_pad), (0, 0)))
     table = table.reshape(-1, _LANES)                    # [Vp·K/LANES, LANES]
 
-    n = batch * f_size
-    n_pad = (-n) % _N_TILE
-    flat_ids = jnp.pad(ids.reshape(-1), (0, n_pad))
-    flat_vals = jnp.pad(vals.astype(jnp.float32).reshape(-1), (0, n_pad))
-    win = flat_ids // per_win
-    sel = flat_ids % per_win
-
+    n = win.shape[0]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=((n + n_pad) // _N_TILE,),
+        num_scalar_prefetch=3,                           # win, first, dma_rows
+        grid=(n // _N_TILE,),
         in_specs=[
-            pl.BlockSpec((_N_TILE, 1), lambda i, w: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((_N_TILE, 1), lambda i, w: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_N_TILE, 1), lambda i, *_: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_N_TILE, 1), lambda i, *_: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.HBM),
         ],
         out_specs=pl.BlockSpec(
-            (_N_TILE, k), lambda i, w: (i, 0), memory_space=pltpu.VMEM
+            (_N_TILE, k), lambda i, *_: (i, 0), memory_space=pltpu.VMEM
         ),
         scratch_shapes=[
             pltpu.VMEM((_N_TILE, _LANES), jnp.float32),
             pltpu.SemaphoreType.DMA((_NSEM,)),
         ],
     )
-    emb_flat = pl.pallas_call(
-        _gather_kernel,
+    return pl.pallas_call(
+        functools.partial(_gather_unique_kernel, per_win=per_win),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((n + n_pad, k), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((n, k), jnp.float32),
         interpret=interpret,
-    )(win, sel[:, None], flat_vals[:, None], table)
-    return emb_flat[:n].reshape(batch, f_size, k)
+    )(win, first, dma_rows, sel[:, None], dist[:, None], table)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
 def fused_ctr_interaction(fm_w, fm_v, ids, vals, interpret=False):
     """Fused gather + FM: (fm_w [V], fm_v [V,K], ids [B,F], vals [B,F]) ->
     (emb [B,F,K], y_w [B], y_v [B]).  emb is already vals-scaled (ps:212-214);
-    y_w/y_v are the first/second-order FM terms (ps:207-217)."""
-    return _forward(fm_w, fm_v, ids, vals, interpret)
+    y_w/y_v are the first/second-order FM terms (ps:207-217).  Out-of-range
+    ids clip to [0, V-1] like ``jnp.take(mode='clip')``."""
+    out, _ = _forward(fm_w, fm_v, ids, vals, interpret)
+    return out
 
 
 def _forward(fm_w, fm_v, ids, vals, interpret):
     ids = ids.reshape(-1, ids.shape[-1])
     vals = vals.astype(jnp.float32)
-    emb = _gather_scaled(fm_v, ids, vals, interpret=interpret)
+    b, f = ids.shape
+    v, k = fm_v.shape
+    ids = jnp.clip(ids.astype(jnp.int32), 0, v - 1)
+    flat = ids.reshape(-1)
+    uids, inv, valid, win, sel, first, dist, dma_rows = _dedup_plan(
+        flat, _LANES // k
+    )
+    rows_u = _gather_unique(
+        fm_v, win, sel, first, dist, dma_rows, interpret=interpret
+    )
+    emb = rows_u[inv].reshape(b, f, k) * vals[..., None]
     # small gather + reductions stay in XLA: fused into one pass over emb
-    w_rows = jnp.take(fm_w, jnp.clip(ids, 0, fm_w.shape[0] - 1), axis=0)
+    w_rows = jnp.take(fm_w, ids, axis=0)
     y_w = jnp.sum(w_rows * vals, axis=1)
     sum_e = jnp.sum(emb, axis=1)
     y_v = 0.5 * jnp.sum(
         jnp.square(sum_e) - jnp.sum(jnp.square(emb), axis=1), axis=1
     )
-    return emb, y_w, y_v
+    return (emb, y_w, y_v), (ids, uids, inv, valid, rows_u)
 
 
 def _fused_fwd(fm_w, fm_v, ids, vals, interpret):
-    out = _forward(fm_w, fm_v, ids, vals, interpret)
-    return out, (fm_w, fm_v, ids, vals)
+    out, (ids2d, uids, inv, valid, rows_u) = _forward(
+        fm_w, fm_v, ids, vals, interpret
+    )
+    return out, (fm_w, fm_v, ids2d, vals, uids, inv, valid, rows_u)
 
 
 def _fused_bwd(interpret, res, cotangents):
-    fm_w, fm_v, ids, vals = res
+    """Backward in plain XLA, deduplicated: row grads are segment-summed by
+    the forward's inverse map, so the table scatter-add touches each unique
+    row once — no duplicate-index serialization on skewed ids."""
+    fm_w, fm_v, ids, vals, uids, inv, valid, rows_u = res
     g_emb, g_yw, g_yv = cotangents
-    ids = jnp.clip(ids, 0, fm_v.shape[0] - 1)
+    v, k = fm_v.shape
     vals = vals.astype(jnp.float32)
-    w_rows = jnp.take(fm_w, ids, axis=0)                   # [B, F]
-    v_rows = jnp.take(fm_v, ids, axis=0)                   # [B, F, K]
+    v_rows = rows_u[inv].reshape(*ids.shape, k)            # [B, F, K]
     e = v_rows * vals[..., None]
     sum_e = jnp.sum(e, axis=1)                             # [B, K]
     # ∂y_v/∂e_bfk = Σ_f' e_bf'k − e_bfk  (derivative of the FM identity)
     g_e = g_emb + g_yv[:, None, None] * (sum_e[:, None, :] - e)
     d_v_rows = g_e * vals[..., None]
-    flat_ids = ids.reshape(-1)
-    d_fm_v = jnp.zeros_like(fm_v).at[flat_ids].add(
-        d_v_rows.reshape(-1, fm_v.shape[1])
+    n_seg = uids.shape[0]
+    d_u = jax.ops.segment_sum(
+        d_v_rows.reshape(-1, k), inv, num_segments=n_seg
     )
-    d_fm_w = jnp.zeros_like(fm_w).at[flat_ids].add(
-        (g_yw[:, None] * vals).reshape(-1)
+    d_uw = jax.ops.segment_sum(
+        (g_yw[:, None] * vals).reshape(-1), inv, num_segments=n_seg
     )
+    scatter_idx = jnp.where(valid, uids, v)                # OOB pads drop
+    d_fm_v = jnp.zeros_like(fm_v).at[scatter_idx].add(d_u, mode="drop")
+    d_fm_w = jnp.zeros_like(fm_w).at[scatter_idx].add(d_uw, mode="drop")
+    w_rows = jnp.take(fm_w, ids, axis=0)
     d_vals = jnp.sum(g_e * v_rows, axis=-1) + g_yw[:, None] * w_rows
     return d_fm_w, d_fm_v, None, d_vals.astype(vals.dtype)
 
