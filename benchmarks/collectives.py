@@ -126,14 +126,43 @@ def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--mb", type=float, default=64.0, help="payload size in MB")
     p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--persist", action="store_true",
+                   help="append results to docs/BENCH_COLLECTIVES.json")
     args = p.parse_args()
 
     devices = np.array(jax.devices())
+    rows = []
     with Mesh(devices.reshape(-1), ("data",)) as mesh:
         for row in bench_collectives(mesh, args.mb, args.iters):
+            rows.append(row)
             print(json.dumps(row))
     with Mesh(devices.reshape(-1), ("model",)) as mesh:
-        print(json.dumps(bench_sharded_lookup(mesh, args.iters)))
+        row = bench_sharded_lookup(mesh, args.iters)
+        rows.append(row)
+        print(json.dumps(row))
+    if args.persist:
+        out = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "docs", "BENCH_COLLECTIVES.json",
+        )
+        history = []
+        if os.path.exists(out):
+            try:
+                with open(out) as fp:
+                    history = json.load(fp).get("runs", [])
+            except Exception:
+                history = []
+        entry = {
+            "platform": jax.devices()[0].platform,
+            "device_count": int(devices.size),
+            "mb": args.mb,
+            "recorded_unix_time": int(time.time()),
+            "results": rows,
+        }
+        history.append(entry)
+        with open(out, "w") as fp:
+            json.dump({"latest": entry, "runs": history}, fp, indent=1)
+        print(f"persisted to {out}", file=sys.stderr)
     return 0
 
 
