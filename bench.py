@@ -116,7 +116,10 @@ def main() -> None:
     sanitize_backend()
     import jax
 
-    platform = jax.devices()[0].platform
+    from deepfm_tpu.core.platform import is_tpu_backend
+
+    # normalize tunneled TPU plugins that report their own platform name
+    platform = "tpu" if is_tpu_backend() else jax.devices()[0].platform
     from deepfm_tpu.core.config import Config
 
     cfg = Config.from_dict(

@@ -16,6 +16,21 @@ import os
 _TUNNEL_PLATFORMS = ("axon",)
 
 
+def is_tpu_backend() -> bool:
+    """True when the default backend is TPU hardware — including tunneled
+    PJRT plugins that register under their own platform name (e.g. "axon")
+    but expose TPU devices (device_kind "TPU v5e" etc.)."""
+    try:
+        import jax
+
+        d = jax.devices()[0]
+        if d.platform == "tpu":
+            return True
+        return "tpu" in getattr(d, "device_kind", "").lower()
+    except Exception:
+        return False
+
+
 def sanitize_backend() -> None:
     requested = os.environ.get("JAX_PLATFORMS", "")
     if any(p in requested for p in _TUNNEL_PLATFORMS):

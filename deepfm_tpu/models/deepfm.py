@@ -156,10 +156,12 @@ def apply_deepfm(
             )
         use_fused = False  # "auto": quietly keep the XLA gather path
     if use_fused:
+        from ..core.platform import is_tpu_backend
+
         # one HBM pass: both gathers + scaling + FM sums (ops/pallas_ctr.py)
         emb, y_w, y_v = fused_ctr_interaction(
             params["fm_w"], params["fm_v"], feat_ids, feat_vals,
-            jax.default_backend() != "tpu",  # interpret on CPU (tests)
+            not is_tpu_backend(),  # interpret on CPU (tests)
         )
     else:
         # first order (ps:206-209)

@@ -296,10 +296,9 @@ fused_ctr_interaction.defvjp(_fused_fwd, _fused_bwd)
 
 def fused_kernel_available() -> bool:
     """True when the default backend can run the kernel compiled (TPU)."""
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:
-        return False
+    from ..core.platform import is_tpu_backend
+
+    return is_tpu_backend()
 
 
 def resolve_fused(setting: str) -> bool:
