@@ -258,6 +258,15 @@ def run_train(cfg: Config) -> TrainState:
 def run_infer(cfg: Config, *, output_path: str | None = None) -> str:
     """INFER task: batch-score te*/test* records to pred.txt (ps:526-533)."""
     ctx = setup(cfg)
+    if jax.process_count() > 1:
+        # predict output is data-sharded across processes; device_get of
+        # non-addressable shards cannot work.  The reference's infer is a
+        # single-host batch job too (ps:526-533) — run it that way.
+        raise RuntimeError(
+            "task_type=infer is a single-process batch job; run it without "
+            "DEEPFM_COORDINATOR (the trained model_dir restores fine on one "
+            "process — shardings adapt to the local mesh)"
+        )
     ckpt = Checkpointer(cfg.run.model_dir)
     state = ckpt.restore(create_spmd_state(ctx))
     predict_step = make_spmd_predict_step(ctx)

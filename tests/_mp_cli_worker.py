@@ -1,0 +1,58 @@
+"""Worker subprocess for the 2-process CLI lifecycle test: runs the REAL
+launcher (`deepfm_tpu.launch.cli.main`) under `jax.distributed`, one process
+per "host", sharing a model_dir — the reference's 2-instance SageMaker job
+(ps notebook cells 4-5) driven end to end through the CLI.
+
+Run:  python _mp_cli_worker.py <port> <rank> <workdir>
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    port, rank, workdir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    # the mpirun-analog env contract (launch/cli.py docstring)
+    os.environ["DEEPFM_COORDINATOR"] = f"localhost:{port}"
+    os.environ["DEEPFM_NUM_PROCESSES"] = "2"
+    os.environ["DEEPFM_PROCESS_ID"] = str(rank)
+    os.environ["DEEPFM_HOSTS"] = "host0,host1"
+    os.environ["DEEPFM_CURRENT_HOST"] = f"host{rank}"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+
+    from deepfm_tpu.launch.cli import main as cli_main
+
+    cli_main(
+        [
+            "--task_type", "train",
+            "--training_data_dir", workdir,
+            "--val_data_dir", workdir,
+            "--model_dir", os.path.join(workdir, "model"),
+            "--feature_size", "300",
+            "--field_size", "6",
+            "--embedding_size", "4",
+            "--deep_layers", "8",
+            "--batch_size", "16",
+            "--num_epochs", "2",
+            "--set", "model.dropout_keep=[1.0]",
+            "--set", "model.compute_dtype=float32",
+            "--set", "run.log_steps=8",
+            "--set", "run.checkpoint_every_steps=5",
+            "--set", f"run.servable_model_dir={os.path.join(workdir, 'servable')}",
+            "--set", "mesh.data_parallel=4",
+            "--set", "mesh.model_parallel=2",
+        ]
+    )
+    import jax
+
+    print(f"MP_CLI_OK rank={rank} processes={jax.process_count()}")
+
+
+if __name__ == "__main__":
+    main()
