@@ -274,3 +274,38 @@ def test_permute_ids_bijective():
     permuted = permute_ids(ids, vocab, True)
     assert len(set(np.asarray(permuted).tolist())) == vocab
     np.testing.assert_array_equal(permute_ids(ids, vocab, False), ids)
+
+
+def test_north_star_vocab_shape_inference_only():
+    """The 100M-row north-star table (BASELINE.md) must flow through context
+    construction — padding, sharding specs, optimizer-state layout — via
+    shape inference alone: make_context materializes nothing, so this also
+    pins that property (a 100M x 32 f32 table + Adam moments would be
+    ~38 GB)."""
+    from deepfm_tpu.core.config import Config, MeshConfig
+    from deepfm_tpu.parallel import build_mesh, make_context
+    from deepfm_tpu.parallel.mesh import MODEL_AXIS
+    from jax.sharding import PartitionSpec as P
+
+    cfg = Config.from_dict(
+        {
+            "model": {
+                "feature_size": 100_000_000,
+                "field_size": 39,
+                "embedding_size": 32,
+                "deep_layers": (128, 64, 32),
+                "dropout_keep": (0.5, 0.5, 0.5),
+            },
+            "optimizer": {"lazy_embedding_updates": True},
+        }
+    )
+    mesh = build_mesh(MeshConfig(data_parallel=2, model_parallel=4))
+    ctx = make_context(cfg, mesh)
+    pv = ctx.cfg.model.feature_size
+    assert pv >= 100_000_000 and pv % 4 == 0
+    assert ctx.state_specs.params["fm_v"] == P(MODEL_AXIS, None)
+    assert ctx.state_specs.params["fm_w"] == P(MODEL_AXIS)
+    # lazy optimizer state mirrors the row sharding (moments live with rows)
+    _, lazy_specs = ctx.state_specs.opt_state
+    assert lazy_specs.m["fm_v"] == P(MODEL_AXIS, None)
+    assert lazy_specs.v["fm_w"] == P(MODEL_AXIS)
