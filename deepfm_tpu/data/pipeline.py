@@ -170,6 +170,53 @@ def ctr_batches_from_sources(
     )
 
 
+def shuffle_batches(
+    batches: Iterator[dict], *, buffer_records: int, seed: int = 0
+) -> Iterator[dict]:
+    """Windowed record-level shuffle over a decoded batch stream — the
+    ``tf.data.shuffle(buffer_size)`` capability (the reference declared a
+    ``perform_shuffle`` hyperparameter but never wired it, SURVEY §2a; here
+    ``DataConfig.shuffle_buffer`` wires it for real).
+
+    Accumulates ~``buffer_records`` rows, permutes the pool, emits the front
+    half as batches and keeps the tail to mix with the next window — an
+    approximation of reservoir sampling that works identically over the
+    native (whole-batch) and pure-Python sources.  Deterministic given
+    ``seed``.  Note: combined with input-position resume, the skip applies
+    to the SOURCE stream; the shuffled order after resume differs from the
+    uninterrupted run (same records, different order).
+    """
+    rng = np.random.default_rng(seed)
+    pool: list[dict] = []
+    pooled = 0
+    batch_size = None
+
+    def drain(keep_tail: bool) -> Iterator[dict]:
+        nonlocal pool, pooled
+        if not pool:
+            return
+        keys = list(pool[0])
+        merged = {k: np.concatenate([b[k] for b in pool]) for k in keys}
+        n = merged[keys[0]].shape[0]
+        order = rng.permutation(n)
+        emit_rows = (n // 2 // batch_size) * batch_size if keep_tail else n
+        for i in range(0, emit_rows, batch_size):
+            idx = order[i : i + batch_size]
+            yield {k: v[idx] for k, v in merged.items()}
+        tail = order[emit_rows:]
+        pool = [{k: v[tail] for k, v in merged.items()}] if tail.size else []
+        pooled = tail.size
+
+    for b in batches:
+        if batch_size is None:
+            batch_size = int(b["label"].shape[0])
+        pool.append(b)
+        pooled += int(b["label"].shape[0])
+        if pooled >= buffer_records + batch_size:
+            yield from drain(keep_tail=True)
+    yield from drain(keep_tail=False)
+
+
 class InMemoryDataset:
     """Decode-once cache: the whole dataset as contiguous arrays.
 
@@ -261,18 +308,30 @@ def make_input_pipeline(
     permute_vocab = feature_size if cfg.permute_ids else 0
     epochs = cfg.num_epochs if num_epochs is None else num_epochs
     base_dir = data_dir if data_dir is not None else cfg.training_data_dir
+
+    def maybe_shuffled(batches: Iterator[dict], epoch: int) -> Iterator[dict]:
+        if cfg.shuffle_buffer > 0:
+            return shuffle_batches(
+                batches, buffer_records=cfg.shuffle_buffer,
+                seed=seed + 7919 * epoch,   # reshuffle each epoch
+            )
+        return batches
+
     if cfg.stream_mode:
         # stream channels live at <dir>/<channel> (+ "-<k>" per extra local
         # worker, mirroring the reference's channel naming, hvd nb cell 8)
         suffix = f"-{decision.channel_index}" if decision.channel_index else ""
         fifo = os.path.join(base_dir, f"{channel}{suffix}")
-        yield from ctr_batches_from_sources(
-            [fifo],
-            batch_size=cfg.batch_size,
-            field_size=field_size,
-            decision=decision,
-            drop_remainder=cfg.drop_remainder,
-            permute_vocab=permute_vocab,
+        yield from maybe_shuffled(
+            ctr_batches_from_sources(
+                [fifo],
+                batch_size=cfg.batch_size,
+                field_size=field_size,
+                decision=decision,
+                drop_remainder=cfg.drop_remainder,
+                permute_vocab=permute_vocab,
+            ),
+            0,
         )
         return
     # seeded shuffle: every host MUST enumerate files in the same order, or
@@ -285,15 +344,18 @@ def make_input_pipeline(
             f"no {tuple(cfg.file_patterns)}*.tfrecords under {base_dir!r}"
         )
     skip_counter = [max(0, skip_batches)]
-    for _ in range(max(1, epochs)):
-        yield from ctr_batches_from_sources(
-            files,
-            batch_size=cfg.batch_size,
-            field_size=field_size,
-            decision=decision,
-            drop_remainder=cfg.drop_remainder,
-            permute_vocab=permute_vocab,
-            skip_counter=skip_counter,
+    for epoch in range(max(1, epochs)):
+        yield from maybe_shuffled(
+            ctr_batches_from_sources(
+                files,
+                batch_size=cfg.batch_size,
+                field_size=field_size,
+                decision=decision,
+                drop_remainder=cfg.drop_remainder,
+                permute_vocab=permute_vocab,
+                skip_counter=skip_counter,
+            ),
+            epoch,
         )
 
 

@@ -176,6 +176,22 @@ def _sharded_penalty(params: dict, l2_reg: float) -> jnp.ndarray:
     return l2_reg * total
 
 
+def _sync_model_state(model_state):
+    """Replicate non-trainable state (BN moving stats) across the mesh.
+
+    Inside shard_map each data shard updates the moving mean/var from its
+    LOCAL batch slice; without a reduction the out_specs' "replicated" claim
+    would silently hold different values per device (and the checkpoint
+    would record an arbitrary shard's).  pmean over the data axis yields
+    cross-replica synced statistics — the reference's Horovod path kept
+    per-worker stats and checkpointed rank 0's (hvd:402-415); averaging is
+    the strictly-better invariant.  The model-axis pmean is numerically a
+    no-op (replicas see identical batches) but pins bit-identity."""
+    return jax.tree_util.tree_map(
+        lambda x: lax.pmean(lax.pmean(x, DATA_AXIS), MODEL_AXIS), model_state
+    )
+
+
 def _pmean_grads(grads: dict) -> dict:
     """Sync gradients: every leaf pmean-ed over the data axis (the Horovod
     DistributedOptimizer capability, hvd:296); replicated (non-table) leaves
@@ -236,6 +252,7 @@ def make_spmd_train_step(ctx: SPMDContext, *, donate: bool = True) -> Callable:
         (loss, (ce, logits, new_model_state)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params)
+        new_model_state = _sync_model_state(new_model_state)
         grads = _pmean_grads(grads)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
@@ -331,6 +348,7 @@ def _make_lazy_spmd_train_step(
         (loss, (logits, new_model_state)), (g_rest, g_rows) = jax.value_and_grad(
             loss_fn, argnums=(0, 1), has_aux=True
         )(rest, rows)
+        new_model_state = _sync_model_state(new_model_state)
         g_rest = _pmean_grads(g_rest)
         rest_opt, lazy_state = state.opt_state
         updates, new_rest_opt = tx.update(g_rest, rest_opt, rest)

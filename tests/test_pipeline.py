@@ -223,3 +223,66 @@ def test_skip_batches_keep_remainder(tmp_path, monkeypatch, force_python):
         assert len(resumed) == 6 - skip
         for got, want in zip(resumed, full[skip:]):
             np.testing.assert_array_equal(got["feat_ids"], want["feat_ids"])
+
+
+def test_shuffle_batches_permutes_and_preserves_records():
+    from deepfm_tpu.data.pipeline import shuffle_batches
+
+    def batches(n_batches, bs=8):
+        for t in range(n_batches):
+            base = t * bs
+            yield {
+                "feat_ids": np.arange(base, base + bs).reshape(bs, 1),
+                "feat_vals": np.ones((bs, 1), np.float32),
+                "label": np.zeros(bs, np.float32),
+            }
+
+    out = list(shuffle_batches(batches(16), buffer_records=32, seed=0))
+    ids = np.concatenate([b["feat_ids"].reshape(-1) for b in out])
+    # same multiset of records, batches stay full-size
+    np.testing.assert_array_equal(np.sort(ids), np.arange(128))
+    assert all(b["feat_ids"].shape[0] == 8 for b in out)
+    # actually shuffled
+    assert not np.array_equal(ids, np.arange(128))
+    # deterministic per seed, different across seeds
+    ids2 = np.concatenate(
+        [b["feat_ids"].reshape(-1)
+         for b in shuffle_batches(batches(16), buffer_records=32, seed=0)]
+    )
+    np.testing.assert_array_equal(ids, ids2)
+    ids3 = np.concatenate(
+        [b["feat_ids"].reshape(-1)
+         for b in shuffle_batches(batches(16), buffer_records=32, seed=1)]
+    )
+    assert not np.array_equal(ids, ids3)
+    # locality: a record cannot be EMITTED before it was read — its output
+    # position is at most ~one buffer window ahead of its source position.
+    # (Forward drift is unbounded, as in tf.data's reservoir: a record may
+    # linger in the kept tail across windows.)
+    positions = np.empty(128, np.int64)
+    positions[ids] = np.arange(128)
+    assert (positions - np.arange(128)).min() >= -(32 + 16)
+
+
+def test_pipeline_shuffle_buffer_wired(tmp_path):
+    f = _write(tmp_path, "tr.tfrecords", 64)
+    cfg = DataConfig(batch_size=8, shuffle_buffer=24, shuffle_files=False)
+    plain_cfg = DataConfig(batch_size=8, shuffle_buffer=0, shuffle_files=False)
+    topo = WorkerTopology(1, 0, 1, 0)
+    shuffled = list(make_input_pipeline(
+        cfg, topo, field_size=FIELD, data_dir=str(tmp_path), num_epochs=1))
+    plain = list(make_input_pipeline(
+        plain_cfg, topo, field_size=FIELD, data_dir=str(tmp_path), num_epochs=1))
+    a = np.concatenate([b["feat_vals"] for b in shuffled])
+    b = np.concatenate([b["feat_vals"] for b in plain])
+    assert a.shape == b.shape
+    assert not np.array_equal(a, b)          # order changed
+    np.testing.assert_array_equal(           # content identical
+        np.sort(a.reshape(-1)), np.sort(b.reshape(-1))
+    )
+    # two epochs reshuffle differently
+    two = list(make_input_pipeline(
+        cfg, topo, field_size=FIELD, data_dir=str(tmp_path), num_epochs=2))
+    e1 = np.concatenate([b["feat_vals"] for b in two[: len(shuffled)]])
+    e2 = np.concatenate([b["feat_vals"] for b in two[len(shuffled):]])
+    assert not np.array_equal(e1, e2)

@@ -309,3 +309,52 @@ def test_north_star_vocab_shape_inference_only():
     _, lazy_specs = ctx.state_specs.opt_state
     assert lazy_specs.m["fm_v"] == P(MODEL_AXIS, None)
     assert lazy_specs.v["fm_w"] == P(MODEL_AXIS)
+
+
+def test_bn_moving_stats_replicated_across_shards():
+    """BN moving stats are updated from LOCAL batch slices inside shard_map;
+    the step must pmean them back to a true replica (out_specs declare them
+    replicated — without the sync each device would silently hold different
+    statistics and the checkpoint would record an arbitrary shard's)."""
+    from deepfm_tpu.core.config import Config, MeshConfig
+    from deepfm_tpu.parallel import (
+        build_mesh, create_spmd_state, make_context, make_spmd_train_step,
+        shard_batch,
+    )
+
+    cfg = Config.from_dict(
+        {
+            "model": {
+                "feature_size": 200,
+                "field_size": 5,
+                "embedding_size": 4,
+                "deep_layers": (8,),
+                "dropout_keep": (1.0,),
+                "batch_norm": True,
+                "compute_dtype": "float32",
+            },
+            "optimizer": {"learning_rate": 0.01},
+        }
+    )
+    mesh = build_mesh(MeshConfig(data_parallel=4, model_parallel=2))
+    ctx = make_context(cfg, mesh)
+    state = create_spmd_state(ctx)
+    step = make_spmd_train_step(ctx, donate=False)
+    rng = np.random.default_rng(0)
+    for i in range(3):
+        batch = {
+            "feat_ids": rng.integers(0, 200, size=(32, 5)),
+            "feat_vals": rng.normal(size=(32, 5)).astype(np.float32),
+            "label": (rng.random(32) < 0.3).astype(np.float32),
+        }
+        state, m = step(state, shard_batch(ctx, batch))
+    bn = state.model_state["bn"]["layer_0"]
+    mean_shards = [np.asarray(s.data) for s in bn.moving_mean.addressable_shards]
+    var_shards = [np.asarray(s.data) for s in bn.moving_var.addressable_shards]
+    for s in mean_shards[1:]:
+        np.testing.assert_array_equal(mean_shards[0], s)
+    for s in var_shards[1:]:
+        np.testing.assert_array_equal(var_shards[0], s)
+    # and the stats actually moved off their init (zeros / ones)
+    assert np.abs(mean_shards[0]).max() > 0
+    assert np.isfinite(float(m["loss"]))
