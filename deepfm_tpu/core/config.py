@@ -137,10 +137,11 @@ class DataConfig:
 @dataclass(frozen=True)
 class MeshConfig:
     """Device mesh topology.  Replaces PS topology flags (ps:38-48) and
-    Horovod rank plumbing (hvd:333-350) with named mesh axes."""
+    Horovod rank plumbing (hvd:333-350) with named mesh axes.  The axis
+    NAMES are fixed framework-wide ("data"/"model",
+    parallel/mesh.DATA_AXIS/MODEL_AXIS) — they appear in every sharding
+    rule, so they are constants, not configuration."""
 
-    data_axis: str = "data"
-    model_axis: str = "model"
     # -1 = all remaining devices on that axis
     data_parallel: int = -1
     model_parallel: int = 1           # row-shard factor for embedding tables
@@ -214,14 +215,36 @@ class Config:
 
     @classmethod
     def from_dict(cls, d: dict) -> "Config":
+        """Build from a nested dict (the config.json schema).
+
+        Unknown keys are dropped with a warning rather than raising: saved
+        configs (servables, checkpoints) must keep loading across framework
+        versions that add or retire fields.  CLI ``--set`` overrides go
+        through ``with_overrides`` instead, which still rejects typos."""
+
+        def known(section_cls, section: dict, name: str) -> dict:
+            fields = {f.name for f in dataclasses.fields(section_cls)}
+            out = {}
+            for k, v in section.items():
+                if k not in fields:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "config: ignoring unknown field %s.%s "
+                        "(saved by a different framework version?)", name, k
+                    )
+                    continue
+                out[k] = tuple(v) if isinstance(v, list) else v
+            return out
+
         return cls(
-            model=ModelConfig(**d.get("model", {})),
-            optimizer=OptimizerConfig(**d.get("optimizer", {})),
-            data=DataConfig(**{k: tuple(v) if isinstance(v, list) else v
-                               for k, v in d.get("data", {}).items()}),
-            mesh=MeshConfig(**d.get("mesh", {})),
-            run=RunConfig(**{k: tuple(v) if isinstance(v, list) else v
-                             for k, v in d.get("run", {}).items()}),
+            model=ModelConfig(**known(ModelConfig, d.get("model", {}), "model")),
+            optimizer=OptimizerConfig(
+                **known(OptimizerConfig, d.get("optimizer", {}), "optimizer")
+            ),
+            data=DataConfig(**known(DataConfig, d.get("data", {}), "data")),
+            mesh=MeshConfig(**known(MeshConfig, d.get("mesh", {}), "mesh")),
+            run=RunConfig(**known(RunConfig, d.get("run", {}), "run")),
         )
 
     @classmethod

@@ -1,0 +1,20 @@
+"""Config schema tests."""
+
+from deepfm_tpu.core.config import Config
+
+
+def test_from_dict_ignores_unknown_fields(caplog):
+    """Saved configs must keep loading across framework versions: unknown
+    fields (e.g. the retired mesh.data_axis) are dropped with a warning."""
+    import logging
+
+    with caplog.at_level(logging.WARNING):
+        cfg = Config.from_dict(
+            {
+                "mesh": {"data_axis": "data", "model_parallel": 2},
+                "model": {"feature_size": 99, "retired_knob": 1},
+            }
+        )
+    assert cfg.mesh.model_parallel == 2
+    assert cfg.model.feature_size == 99
+    assert any("unknown field" in r.message for r in caplog.records)
