@@ -1,0 +1,27 @@
+#!/bin/bash
+# One-shot real-TPU measurement session — run when the tunneled chip is
+# reachable (the tunnel watcher invokes this; it is safe to re-run).
+# Persists: BENCH_TPU.json (bench.py), docs/BENCH_COLLECTIVES.json,
+# docs/BENCH_INGEST.json, and a compiled (non-interpret) Pallas
+# correctness check.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+export DEEPFM_TPU_ATTACH_TIMEOUT="${DEEPFM_TPU_ATTACH_TIMEOUT:-300}"
+status=0
+
+echo "== pallas compiled correctness (DEEPFM_TEST_TPU=1 -> interpret off) =="
+JAX_PLATFORMS=axon DEEPFM_TEST_TPU=1 timeout 1800 \
+    python -m pytest tests/test_pallas_ctr.py -q || status=1
+
+echo "== single-chip bench (persists BENCH_TPU.json on success) =="
+JAX_PLATFORMS=axon timeout 1800 python bench.py || status=1
+
+echo "== collective microbench (1 chip: records the no-comm floor) =="
+JAX_PLATFORMS=axon timeout 1200 \
+    python benchmarks/collectives.py --mb 64 --persist || status=1
+
+echo "== end-to-end ingest on TPU =="
+JAX_PLATFORMS=axon timeout 1800 \
+    python benchmarks/ingest.py --records 200000 --persist || status=1
+
+exit $status

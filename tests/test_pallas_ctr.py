@@ -14,7 +14,11 @@ from deepfm_tpu.core.config import Config
 from deepfm_tpu.models import get_model
 from deepfm_tpu.ops.embedding import dense_lookup, scaled_embedding
 from deepfm_tpu.ops.fm import fm_first_order, fm_second_order
+from deepfm_tpu.core.platform import is_tpu_backend
 from deepfm_tpu.ops.pallas_ctr import fused_ctr_interaction
+
+# compiled on real TPU (DEEPFM_TEST_TPU=1), interpret mode on CPU CI
+INTERPRET = not is_tpu_backend()
 from deepfm_tpu.train import create_train_state
 
 
@@ -35,7 +39,7 @@ def _oracle(fm_w, fm_v, ids, vals):
 @pytest.mark.parametrize("batch", [48, 10, 1])  # 10, 1: exercise padding
 def test_forward_matches_oracle(batch):
     fm_w, fm_v, ids, vals = _random_problem(batch=batch)
-    emb, y_w, y_v = fused_ctr_interaction(fm_w, fm_v, ids, vals, True)
+    emb, y_w, y_v = fused_ctr_interaction(fm_w, fm_v, ids, vals, INTERPRET)
     emb_o, y_w_o, y_v_o = _oracle(fm_w, fm_v, ids, vals)
     np.testing.assert_allclose(emb, emb_o, rtol=1e-6, atol=1e-6)
     np.testing.assert_allclose(y_w, y_w_o, rtol=1e-5, atol=1e-5)
@@ -45,7 +49,7 @@ def test_forward_matches_oracle(batch):
 def test_clips_out_of_range_ids_like_xla():
     fm_w, fm_v, ids, vals = _random_problem()
     bad = ids.at[0, 0].set(10_000_000).at[1, 1].set(-3)
-    emb, y_w, y_v = fused_ctr_interaction(fm_w, fm_v, bad, vals, True)
+    emb, y_w, y_v = fused_ctr_interaction(fm_w, fm_v, bad, vals, INTERPRET)
     emb_o, y_w_o, y_v_o = _oracle(fm_w, fm_v, bad, vals)  # take(mode="clip")
     np.testing.assert_allclose(emb, emb_o, rtol=1e-6, atol=1e-6)
     np.testing.assert_allclose(y_w, y_w_o, rtol=1e-5, atol=1e-5)
@@ -68,7 +72,7 @@ def test_gradients_match_oracle():
 
         return loss
 
-    fused = scalar_loss(lambda w, v, x: fused_ctr_interaction(w, v, ids, x, True))
+    fused = scalar_loss(lambda w, v, x: fused_ctr_interaction(w, v, ids, x, INTERPRET))
     oracle = scalar_loss(lambda w, v, x: _oracle(w, v, ids, x))
     got = jax.grad(fused, argnums=(0, 1, 2))(fm_w, fm_v, vals)
     want = jax.grad(oracle, argnums=(0, 1, 2))(fm_w, fm_v, vals)
@@ -114,7 +118,7 @@ def test_forward_and_grads_with_heavy_duplicates():
     ids = jnp.asarray(rng.zipf(1.3, size=(batch, f)) % v, jnp.int32)
     vals = jnp.asarray(rng.normal(size=(batch, f)), jnp.float32)
 
-    emb, y_w, y_v = fused_ctr_interaction(fm_w, fm_v, ids, vals, True)
+    emb, y_w, y_v = fused_ctr_interaction(fm_w, fm_v, ids, vals, INTERPRET)
     emb_o, y_w_o, y_v_o = _oracle(fm_w, fm_v, ids, vals)
     np.testing.assert_allclose(emb, emb_o, rtol=1e-6, atol=1e-6)
     np.testing.assert_allclose(y_w, y_w_o, rtol=1e-5, atol=1e-5)
@@ -128,7 +132,7 @@ def test_forward_and_grads_with_heavy_duplicates():
         ) + jnp.sum(jnp.square(fn(w, t, x)[2]))
 
     got = jax.grad(
-        loss(lambda w, t, x: fused_ctr_interaction(w, t, ids, x, True)),
+        loss(lambda w, t, x: fused_ctr_interaction(w, t, ids, x, INTERPRET)),
         argnums=(0, 1, 2),
     )(fm_w, fm_v, vals)
     want = jax.grad(
