@@ -1,1 +1,2 @@
 from .ckpt import Checkpointer, maybe_clear  # noqa: F401
+from .reshard import restore_resharded  # noqa: F401

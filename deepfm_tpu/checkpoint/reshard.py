@@ -1,0 +1,144 @@
+"""Cross-topology checkpoint restore.
+
+A TrainState checkpoint records embedding tables at the PADDED vocabulary of
+the mesh it was trained on (``padded_vocab`` = next multiple of
+lcm(model_parallel, window_multiple), parallel/spmd.py) — so a run saved on
+a [4, 2] mesh cannot restore byte-for-byte into a [2, 4] context whose
+padding differs.  The reference had no notion of this (one fixed topology
+per job, SURVEY §5); here reshaping the mesh between runs is routine
+(train wide, debug narrow, serve single-chip), so restore must adapt.
+
+``restore_resharded`` restores a checkpoint saved under ANY mesh topology
+into a target :class:`~deepfm_tpu.parallel.spmd.SPMDContext`: every leaf
+living under a table key whose leading dimension is the SAVED padded vocab
+is sliced (dropping only all-zero pad rows — verified, never data) or
+zero-padded to the target padded vocab, then the whole state is placed into
+the target shardings.  Non-table leaves must match shapes exactly.
+
+Single-controller path: the saved arrays are materialized on host during
+adaptation (fine up to tens of millions of rows; a shard-streaming variant
+is the north-star-scale follow-up).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..train.step import TrainState
+from .ckpt import Checkpointer
+
+# mirror parallel/spmd.TABLE_KEYS without importing (keeps this module free
+# of the parallel -> models import chain at import time)
+_TABLE_KEYS = ("fm_w", "fm_v", "embedding", "user_embedding", "item_embedding")
+
+
+def _is_table_leaf(path) -> bool:
+    keys = {getattr(p, "key", None) for p in path}
+    return bool(keys & set(_TABLE_KEYS))
+
+
+def _dictify(x):
+    """Mirror Orbax's on-disk pytree form: NamedTuples -> field dicts
+    (field-less ones -> None), tuples -> lists."""
+    if isinstance(x, tuple) and hasattr(x, "_fields"):
+        if not x._fields:
+            return None
+        return {f: _dictify(getattr(x, f)) for f in x._fields}
+    if isinstance(x, (tuple, list)):
+        return [_dictify(v) for v in x]
+    if isinstance(x, dict):
+        return {k: _dictify(v) for k, v in x.items()}
+    return x
+
+
+def _undictify(template, d):
+    """Rebuild the template's pytree types around dict-form leaves."""
+    if isinstance(template, tuple) and hasattr(template, "_fields"):
+        if not template._fields:
+            return template
+        return type(template)(
+            **{f: _undictify(getattr(template, f), d[f]) for f in template._fields}
+        )
+    if isinstance(template, tuple):
+        return tuple(_undictify(t, v) for t, v in zip(template, d))
+    if isinstance(template, list):
+        return [_undictify(t, v) for t, v in zip(template, d)]
+    if isinstance(template, dict):
+        return {k: _undictify(v, d[k]) for k, v in template.items()}
+    return d
+
+
+def restore_resharded(
+    ckpt: Checkpointer,
+    ctx,
+    step: int | None = None,
+) -> TrainState:
+    """Restore ``ckpt``'s latest (or ``step``) checkpoint into ``ctx``'s
+    mesh/shardings, adapting table row padding between topologies.
+
+    Raises if a slice would drop non-zero rows (i.e. the target vocabulary
+    is genuinely smaller than the data in the checkpoint).
+    """
+    from ..parallel.spmd import _build_full_init
+
+    mngr = ckpt._mngr
+    mngr.wait_until_finished()
+    step = mngr.latest_step() if step is None else step
+    if step is None:
+        raise FileNotFoundError("no checkpoint to restore")
+
+    # target template (shape inference only — nothing materializes)
+    init_fn = _build_full_init(ctx.cfg, ctx.true_feature_size)
+    target_shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    # Orbax stores the state in dict form (NamedTuples -> field dicts,
+    # tuples -> lists); adapt in that form, then rebuild the TrainState
+    target_dict = _dictify(target_shapes)
+
+    # saved template from checkpoint metadata (same dict-form structure)
+    import orbax.checkpoint as ocp
+
+    meta = mngr.item_metadata(step)
+    saved_abstract = jax.tree_util.tree_map(
+        lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype)
+        if hasattr(m, "shape")
+        else m,
+        meta,
+    )
+    raw = mngr.restore(step, args=ocp.args.StandardRestore(saved_abstract))
+
+    def adapt(path, saved, target_shape: jax.ShapeDtypeStruct):
+        saved = np.asarray(saved)
+        if saved.shape == target_shape.shape:
+            return saved
+        if not _is_table_leaf(path) or saved.ndim == 0 or (
+            saved.shape[1:] != target_shape.shape[1:]
+        ):
+            raise ValueError(
+                f"checkpoint leaf {jax.tree_util.keystr(path)} has shape "
+                f"{saved.shape}, target needs {target_shape.shape} — only "
+                f"table row counts (vocab padding) can be adapted"
+            )
+        rows_t = target_shape.shape[0]
+        if saved.shape[0] > rows_t:
+            dropped = saved[rows_t:]
+            if np.any(dropped != 0):
+                raise ValueError(
+                    f"resharding {jax.tree_util.keystr(path)} from "
+                    f"{saved.shape[0]} to {rows_t} rows would drop non-zero "
+                    f"data — the target feature_size is smaller than the "
+                    f"checkpoint's true vocabulary"
+                )
+            return saved[:rows_t]
+        pad = np.zeros((rows_t - saved.shape[0], *saved.shape[1:]), saved.dtype)
+        return np.concatenate([saved, pad], axis=0)
+
+    adapted = jax.tree_util.tree_map_with_path(adapt, raw, target_dict)
+    state: Any = _undictify(target_shapes, adapted)
+
+    def place(leaf, sharding):
+        return jax.device_put(leaf, sharding)
+
+    return jax.tree_util.tree_map(place, state, ctx.state_shardings)
