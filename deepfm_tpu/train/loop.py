@@ -20,7 +20,7 @@ from typing import Iterator
 import jax
 import numpy as np
 
-from ..checkpoint import Checkpointer, maybe_clear
+from ..checkpoint import Checkpointer, maybe_clear, restore_resharded
 from ..core.config import Config
 from ..launch.preemption import PreemptedError, PreemptionGuard
 from ..data.pipeline import DevicePrefetcher, InMemoryDataset, discover_files, make_input_pipeline
@@ -161,6 +161,25 @@ def _eval_dataset(cfg: Config, ctx: SPMDContext) -> InMemoryDataset:
     )
 
 
+def restore_latest(
+    ckpt: Checkpointer, ctx: SPMDContext, state: TrainState,
+    log: MetricLogger | None = None,
+) -> TrainState:
+    """Restore the latest checkpoint into the running mesh: exact-shape
+    restore first; on a table-shape mismatch (the checkpoint was written
+    under a different mesh topology — padded vocab differs) fall back to
+    the cross-topology resharding restore."""
+    try:
+        return ckpt.restore(state)
+    except Exception as e:
+        msg = str(e)
+        if not any(k in msg for k in ("shape", "Sizes", "fm_v", "embedding")):
+            raise
+        if log is not None:
+            log.event("resume_reshard", reason=msg[:200])
+        return restore_resharded(ckpt, ctx)
+
+
 def run_eval(cfg: Config, ctx: SPMDContext, state: TrainState, log: MetricLogger) -> dict:
     """EVAL task: streaming AUC + mean loss over the FULL validation set
     (ps:282, ps:522-525).  Tail batches are padded to the data-parallel
@@ -198,7 +217,7 @@ def run_train(cfg: Config) -> TrainState:
     ckpt = Checkpointer(cfg.run.model_dir, max_to_keep=cfg.run.keep_checkpoints)
     state = create_spmd_state(ctx)
     if ckpt.latest_step() is not None:
-        state = ckpt.restore(state)
+        state = restore_latest(ckpt, ctx, state, log)
         log.event("resume", step=int(state.step))
     train_step = make_spmd_train_step(ctx)
 
@@ -268,7 +287,7 @@ def run_infer(cfg: Config, *, output_path: str | None = None) -> str:
             "process — shardings adapt to the local mesh)"
         )
     ckpt = Checkpointer(cfg.run.model_dir)
-    state = ckpt.restore(create_spmd_state(ctx))
+    state = restore_latest(ckpt, ctx, create_spmd_state(ctx))
     predict_step = make_spmd_predict_step(ctx)
     # fallback chain, not a union: te*/test* first (the reference's infer
     # globs te* only, ps:526-533); va*/val* only when no test files exist
@@ -298,7 +317,7 @@ def run_export(cfg: Config) -> str:
     """EXPORT task: restore latest checkpoint -> servable (ps:535-551)."""
     ctx = setup(cfg)
     ckpt = Checkpointer(cfg.run.model_dir)
-    state = ckpt.restore(create_spmd_state(ctx))
+    state = restore_latest(ckpt, ctx, create_spmd_state(ctx))
     path = export_servable(ctx.cfg, state, cfg.run.servable_model_dir)
     ckpt.close()
     MetricLogger().event("export", path=path)
@@ -473,7 +492,7 @@ def run_task(cfg: Config):
     if task == "eval":
         ctx = setup(cfg)
         ckpt = Checkpointer(cfg.run.model_dir)
-        state = ckpt.restore(create_spmd_state(ctx))
+        state = restore_latest(ckpt, ctx, create_spmd_state(ctx))
         result = run_eval(cfg, ctx, state, MetricLogger())
         ckpt.close()
         return result

@@ -108,3 +108,32 @@ def test_restore_refuses_data_loss(tmp_path):
     with pytest.raises(ValueError, match="non-zero"):
         restore_resharded(ck, ctx_small)
     ck.close()
+
+
+def test_run_train_resumes_across_topology_change(tmp_path):
+    """The driver's resume path: a job checkpointed on one mesh shape
+    resumes transparently when relaunched with different mesh flags."""
+    import json
+
+    from deepfm_tpu.data import generate_synthetic_ctr
+    from deepfm_tpu.train.loop import run_train
+
+    generate_synthetic_ctr(
+        tmp_path / "tr-0.tfrecords", num_records=64, feature_size=V,
+        field_size=F, seed=1,
+    )
+    base = _cfg().with_overrides(
+        data={"training_data_dir": str(tmp_path), "batch_size": 8,
+              "num_epochs": 1, "shuffle_files": False},
+        run={"model_dir": str(tmp_path / "model"), "servable_model_dir": "",
+             "checkpoint_every_steps": 0, "log_steps": 100},
+    )
+    run_train(base.with_overrides(mesh={"data_parallel": 4,
+                                        "model_parallel": 2}))
+    # relaunch on a different topology with another epoch of data
+    state = run_train(
+        base.with_overrides(mesh={"data_parallel": 2, "model_parallel": 4},
+                            data={"num_epochs": 2})
+    )
+    # first run: 8 steps; resume skips them, second run adds 8 more
+    assert int(state.step) == 16
