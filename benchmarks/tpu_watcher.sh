@@ -1,0 +1,87 @@
+#!/bin/bash
+# Standing TPU-tunnel watcher (VERDICT r02 next-step #1).
+#
+# Runs for the whole round: probes the tunneled TPU attach every
+# PROBE_INTERVAL seconds with a bounded subprocess; every attempt is logged
+# to docs/TPU_WATCHER_LOG.jsonl (timestamp, outcome, latency).  On the first
+# successful attach it fires benchmarks/tpu_session.sh — which persists
+# BENCH_TPU.json, compiled Pallas test results, collective + ingest numbers —
+# and commits those artifacts (with index.lock retries, since the builder may
+# be committing concurrently).  Exits after a successful session, or when
+# MAX_RUNTIME elapses, leaving the attempt log as evidence either way.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+PROBE_INTERVAL="${PROBE_INTERVAL:-600}"       # seconds between probes
+PROBE_TIMEOUT="${PROBE_TIMEOUT:-240}"         # per-probe attach watchdog
+MAX_RUNTIME="${MAX_RUNTIME:-39600}"           # stop watching after 11 h
+LOG=docs/TPU_WATCHER_LOG.jsonl
+mkdir -p docs
+
+start=$(date +%s)
+probe_n=0
+
+log_attempt() {  # $1 = outcome, $2 = latency_s
+    printf '{"ts": %s, "probe": %d, "outcome": "%s", "latency_s": %s}\n' \
+        "$(date +%s)" "$probe_n" "$1" "$2" >> "$LOG"
+}
+
+commit_with_retry() {
+    # A temp GIT_INDEX_FILE isolates this commit from anything the builder
+    # has concurrently staged in the shared index.
+    local paths=() p
+    for p in BENCH_TPU.json docs/BENCH_COLLECTIVES.json \
+        docs/BENCH_INGEST.json docs/TPU_WATCHER_LOG.jsonl \
+        docs/TPU_SESSION_OUT.log; do
+        [[ -e $p ]] && paths+=("$p")
+    done
+    if ! git status --porcelain -- "${paths[@]}" | grep -q .; then
+        echo "watcher: session produced no artifact changes; nothing to commit"
+        return 0
+    fi
+    local idx
+    idx=$(mktemp)
+    for i in $(seq 1 12); do
+        if GIT_INDEX_FILE="$idx" git read-tree HEAD 2>/dev/null \
+            && GIT_INDEX_FILE="$idx" git add "${paths[@]}" 2>/dev/null \
+            && GIT_INDEX_FILE="$idx" git commit \
+                -m "Record real-TPU measurement session artifacts" \
+                >/dev/null 2>&1; then
+            rm -f "$idx"
+            echo "watcher: committed TPU artifacts"
+            return 0
+        fi
+        sleep 10
+    done
+    rm -f "$idx"
+    echo "watcher: commit failed after retries (artifacts still on disk)"
+    return 1
+}
+
+while :; do
+    now=$(date +%s)
+    if (( now - start > MAX_RUNTIME )); then
+        log_attempt "watcher_timeout" 0
+        echo "watcher: max runtime reached without a TPU window"
+        exit 2
+    fi
+    probe_n=$((probe_n + 1))
+    t0=$(date +%s)
+    if JAX_PLATFORMS=axon timeout "$PROBE_TIMEOUT" python -c \
+        "import jax; d = jax.devices(); print('OK', d[0].device_kind)" \
+        >/dev/null 2>&1; then
+        dt=$(( $(date +%s) - t0 ))
+        log_attempt "attach_ok" "$dt"
+        echo "watcher: TPU attach ok after probe $probe_n (${dt}s) — running session"
+        if bash benchmarks/tpu_session.sh > docs/TPU_SESSION_OUT.log 2>&1; then
+            log_attempt "session_ok" 0
+        else
+            log_attempt "session_partial" 0
+        fi
+        commit_with_retry
+        exit 0
+    fi
+    dt=$(( $(date +%s) - t0 ))
+    log_attempt "attach_fail" "$dt"
+    sleep "$PROBE_INTERVAL"
+done

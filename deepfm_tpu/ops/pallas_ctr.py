@@ -30,8 +30,11 @@ row exactly once, in sorted order:
 On Zipf-skewed Criteo ids a batch of 1024×39 lookups hits only ~30-40% as
 many unique rows, and sorted adjacency packs ~`128/K` unique rows per
 window, so HBM traffic drops several-fold exactly where the round-1 kernel
-lost to XLA (hot windows were re-DMA'd per duplicate: ~240µs vs ~104µs per
-train step on v5e).  Uniform ids benefit from the window packing alone.
+lost to XLA (hot windows were re-DMA'd per duplicate; the round-1 v1 kernel
+measured ~240µs vs ~104µs XLA per train step on a v5e — HISTORICAL numbers
+for the superseded kernel, not reproduced for v2; no committed artifact
+backs them until a tunnel window lets tests/test_pallas_ctr.py +
+bench.py run compiled).  Uniform ids benefit from the window packing alone.
 The dedup's sort also pays for the backward: the custom VJP segment-sums
 row gradients by the same inverse map and scatter-adds each unique row
 once — no duplicate-index scatter serialization.

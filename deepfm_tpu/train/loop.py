@@ -23,7 +23,12 @@ import numpy as np
 from ..checkpoint import Checkpointer, maybe_clear, restore_resharded
 from ..core.config import Config
 from ..launch.preemption import PreemptedError, PreemptionGuard
-from ..data.pipeline import DevicePrefetcher, InMemoryDataset, discover_files, make_input_pipeline
+from ..data.pipeline import (
+    DevicePrefetcher,
+    ctr_batches_from_sources,
+    discover_files,
+    make_input_pipeline,
+)
 from ..data.sharding import WorkerTopology
 from ..ops.auc import auc_value
 from ..parallel import (
@@ -82,11 +87,13 @@ def _train_batches(
 
 
 def _padded_batches(
-    ds: InMemoryDataset, batch_size: int, dp: int
+    batches: Iterator[dict], dp: int
 ) -> Iterator[tuple[dict, int]]:
-    """Batches including the tail, padded to the data-parallel multiple;
-    yields (batch, true_count) so metrics can exclude the padding."""
-    for batch in ds.batches(batch_size, drop_remainder=False):
+    """Pads each batch (notably the tail) to the data-parallel multiple;
+    yields (batch, true_count) so metrics can exclude the padding.  Takes a
+    batch *iterator* so eval/infer memory stays O(batch), independent of
+    channel size."""
+    for batch in batches:
         b = int(batch["label"].shape[0])
         pad = (-b) % dp
         if pad:
@@ -111,54 +118,41 @@ def _has_eval_source(cfg: Config) -> bool:
     return bool(cfg.data.val_data_dir)
 
 
-def _eval_dataset(cfg: Config, ctx: SPMDContext) -> InMemoryDataset:
+def _eval_batches(cfg: Config, ctx: SPMDContext) -> Iterator[dict]:
+    """Host batches of the evaluation source, streamed incrementally.
+
+    Never materializes the channel: both the FIFO (pipe-mode) and file paths
+    decode record-by-record through ``ctr_batches_from_sources``, so eval
+    memory is O(batch_size) regardless of channel size — the capability the
+    reference delegated to tf.data's streaming evaluate (hvd:436-441)."""
     permute = ctx.true_feature_size if cfg.data.permute_ids else 0
     if cfg.data.stream_mode:
         # bounded channel read: until the writer closes the FIFO (EOF), or
         # eval_max_batches when set (a live channel may never close).  Each
         # eval pass opens the channel anew — the feeder re-fills it per eval,
         # mirroring pipe-mode's one-FIFO-per-pass semantics.
-        from ..data.pipeline import ctr_batches_from_sources
-
         fifo = _eval_channel_path(cfg)
         if not os.path.exists(fifo):
             raise FileNotFoundError(
                 f"stream_mode eval needs the evaluation channel at {fifo!r} "
                 f"(data.evaluation_channel_name)"
             )
-        batches = ctr_batches_from_sources(
-            [fifo],
-            batch_size=cfg.data.batch_size,
-            field_size=cfg.model.field_size,
-            drop_remainder=False,
-            permute_vocab=permute,
-        )
-        if cfg.data.eval_max_batches > 0:
-            batches = itertools.islice(batches, cfg.data.eval_max_batches)
-        collected = list(batches)
-        if not collected:
-            return InMemoryDataset(
-                np.zeros((0, cfg.model.field_size), np.int64),
-                np.zeros((0, cfg.model.field_size), np.float32),
-                np.zeros((0,), np.float32),
-            )
-        return InMemoryDataset(
-            np.concatenate([b["feat_ids"] for b in collected]),
-            np.concatenate([b["feat_vals"] for b in collected]),
-            np.concatenate([b["label"] for b in collected]),
-        )
-    files = discover_files(
-        cfg.data.val_data_dir or cfg.data.training_data_dir,
-        patterns=("va", "val", "eval"),
-        shuffle=False,
+        sources = [fifo]
+    else:
+        base = cfg.data.val_data_dir or cfg.data.training_data_dir
+        sources = discover_files(base, patterns=("va", "val", "eval"), shuffle=False)
+        if not sources:
+            raise FileNotFoundError(f"no va*/val*/eval* tfrecords under {base!r}")
+    batches = ctr_batches_from_sources(
+        sources,
+        batch_size=cfg.data.batch_size,
+        field_size=cfg.model.field_size,
+        drop_remainder=False,
+        permute_vocab=permute,
     )
-    if not files:
-        raise FileNotFoundError(
-            f"no va*/val*/eval* tfrecords under {cfg.data.val_data_dir!r}"
-        )
-    return InMemoryDataset.from_files(
-        files, cfg.model.field_size, permute_vocab=permute,
-    )
+    if cfg.data.stream_mode and cfg.data.eval_max_batches > 0:
+        batches = itertools.islice(batches, cfg.data.eval_max_batches)
+    return batches
 
 
 def restore_latest(
@@ -185,22 +179,21 @@ def run_eval(cfg: Config, ctx: SPMDContext, state: TrainState, log: MetricLogger
     (ps:282, ps:522-525).  Tail batches are padded to the data-parallel
     multiple with zero-weight rows, so every record counts exactly once."""
     eval_step = make_spmd_eval_step(ctx)
-    ds = _eval_dataset(cfg, ctx)
     dp = ctx.mesh.shape["data"]
     auc_state = new_auc_state()
-    losses, counts = [], 0
-    for batch, true_count in _padded_batches(ds, cfg.data.batch_size, dp):
+    loss_sum, counts = 0.0, 0
+    for batch, true_count in _padded_batches(_eval_batches(cfg, ctx), dp):
         b = batch["label"].shape[0]
         batch["weight"] = np.concatenate(
             [np.ones(true_count, np.float32), np.zeros(b - true_count, np.float32)]
         )
         sb = shard_batch(ctx, batch)
         auc_state, m = eval_step(state, auc_state, sb)
-        losses.append(float(m["loss"]) * true_count)
+        loss_sum += float(m["loss"]) * true_count
         counts += true_count
     result = {
         "auc": float(auc_value(auc_state)),
-        "loss": (sum(losses) / counts) if counts else float("nan"),
+        "loss": (loss_sum / counts) if counts else float("nan"),
         "examples": counts,
     }
     log.event("eval", **result)
@@ -297,17 +290,24 @@ def run_infer(cfg: Config, *, output_path: str | None = None) -> str:
         files = discover_files(base, patterns=("va", "val"), shuffle=False)
     if not files:
         raise FileNotFoundError("no te*/test* (or va*/val*) tfrecords to score")
-    ds = InMemoryDataset.from_files(
-        files, cfg.model.field_size,
+    batches = ctr_batches_from_sources(
+        files,
+        batch_size=cfg.data.batch_size,
+        field_size=cfg.model.field_size,
+        drop_remainder=False,
         permute_vocab=ctx.true_feature_size if cfg.data.permute_ids else 0,
     )
     out = output_path or os.path.join(base, "pred.txt")
-    probs = []
-    for batch, true_count in _padded_batches(ds, cfg.data.batch_size, ctx.mesh.shape["data"]):
-        sb = shard_batch(ctx, batch)
-        p = np.asarray(jax.device_get(predict_step(state, sb)))
-        probs.append(p[:true_count])
-    n = write_predictions(iter(probs), out)
+
+    def _probs() -> Iterator[np.ndarray]:
+        # generator, not a list: predictions stream to disk batch-by-batch,
+        # so infer memory is O(batch) like eval (ps:526-533 writes per line)
+        for batch, true_count in _padded_batches(batches, ctx.mesh.shape["data"]):
+            sb = shard_batch(ctx, batch)
+            p = np.asarray(jax.device_get(predict_step(state, sb)))
+            yield p[:true_count]
+
+    n = write_predictions(_probs(), out)
     ckpt.close()
     MetricLogger().event("infer", path=out, examples=n)
     return out

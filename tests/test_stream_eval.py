@@ -108,6 +108,40 @@ def test_stream_eval_bounded_read(tmp_path):
     assert result["examples"] == 2 * cfg.data.batch_size
 
 
+def test_stream_eval_memory_independent_of_channel_size(tmp_path):
+    """Eval must consume the channel incrementally: host-side peak allocation
+    is O(batch), not O(channel).  A 50x bigger channel may not move the peak
+    by more than a few batches' worth (the old collect-then-InMemoryDataset
+    path scaled linearly and fails this)."""
+    import tracemalloc
+
+    block = _records(1000, seed=4)
+
+    def peak_for(repeats: int) -> int:
+        d = tmp_path / f"ch_{repeats}"
+        d.mkdir()
+        cfg = _cfg(tmp_path, batch_size=512, val_data_dir=str(d))
+        with open(d / "evaluation", "wb") as f:
+            for _ in range(repeats):
+                f.write(block)
+        ctx = make_context(cfg, build_mesh(cfg.mesh))
+        state = create_spmd_state(ctx)
+        # warm up compile caches outside the traced window
+        run_eval(cfg, ctx, state, MetricLogger())
+        tracemalloc.start()
+        result = run_eval(cfg, ctx, state, MetricLogger())
+        _, peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        assert result["examples"] == repeats * 1000
+        return peak
+
+    small = peak_for(2)       # 2k records
+    large = peak_for(100)     # 100k records (~6.4 MB decoded + copies)
+    assert large < small + 3_000_000, (
+        f"eval peak grew with channel size: {small} -> {large} bytes"
+    )
+
+
 def test_stream_eval_missing_channel_raises(tmp_path):
     cfg = _cfg(tmp_path)
     ctx = make_context(cfg, build_mesh(cfg.mesh))
