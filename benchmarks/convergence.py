@@ -32,9 +32,13 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from deepfm_tpu.core.platform import sanitize_backend  # noqa: E402
+from deepfm_tpu.core.platform import (  # noqa: E402
+    relax_cpu_collective_timeouts,
+    sanitize_backend,
+)
 
 sanitize_backend()
+relax_cpu_collective_timeouts()
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402

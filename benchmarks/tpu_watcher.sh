@@ -27,9 +27,12 @@ log_attempt() {  # $1 = outcome, $2 = latency_s
 }
 
 commit_with_retry() {
-    # A temp GIT_INDEX_FILE isolates this commit from anything the builder
-    # has concurrently staged in the shared index.
-    local paths=() p
+    # Concurrency-safe against a builder committing at the same time: build
+    # the tree from a captured HEAD in a temp GIT_INDEX_FILE (never touching
+    # the shared index), then publish with a compare-and-swap update-ref —
+    # if the builder moved HEAD meanwhile, retry on the new tip instead of
+    # silently reverting it.
+    local paths=() p branch old tree new idx
     for p in BENCH_TPU.json docs/BENCH_COLLECTIVES.json \
         docs/BENCH_INGEST.json docs/TPU_WATCHER_LOG.jsonl \
         docs/TPU_SESSION_OUT.log; do
@@ -39,21 +42,23 @@ commit_with_retry() {
         echo "watcher: session produced no artifact changes; nothing to commit"
         return 0
     fi
-    local idx
-    idx=$(mktemp)
+    branch=$(git symbolic-ref HEAD)
     for i in $(seq 1 12); do
-        if GIT_INDEX_FILE="$idx" git read-tree HEAD 2>/dev/null \
+        old=$(git rev-parse HEAD)
+        idx=$(mktemp)
+        if GIT_INDEX_FILE="$idx" git read-tree "$old" 2>/dev/null \
             && GIT_INDEX_FILE="$idx" git add "${paths[@]}" 2>/dev/null \
-            && GIT_INDEX_FILE="$idx" git commit \
-                -m "Record real-TPU measurement session artifacts" \
-                >/dev/null 2>&1; then
+            && tree=$(GIT_INDEX_FILE="$idx" git write-tree 2>/dev/null) \
+            && new=$(git commit-tree "$tree" -p "$old" \
+                -m "Record real-TPU measurement session artifacts" 2>/dev/null) \
+            && git update-ref "$branch" "$new" "$old" 2>/dev/null; then
             rm -f "$idx"
-            echo "watcher: committed TPU artifacts"
+            echo "watcher: committed TPU artifacts as $new"
             return 0
         fi
+        rm -f "$idx"
         sleep 10
     done
-    rm -f "$idx"
     echo "watcher: commit failed after retries (artifacts still on disk)"
     return 1
 }

@@ -129,6 +129,10 @@ class DataConfig:
     eval_max_batches: int = 0
     prefetch_batches: int = 2         # double-buffered host->device feed
     file_patterns: tuple[str, ...] = ("tr", "train")
+    # concurrent per-source C++ readers for multi-shard ingest (the
+    # multi-channel/multi-shard feed capability, hvd nb cell 8); 1 =
+    # sequential.  Only takes effect with the native reader and >1 source.
+    parallel_readers: int = 4
     # spread Zipf-hot ids across embedding shards with a fixed bijective
     # permutation (host-side, parallel/embedding.permute_ids)
     permute_ids: bool = False

@@ -31,6 +31,38 @@ def is_tpu_backend() -> bool:
         return False
 
 
+def host_cpu_count() -> int:
+    """Usable host cores (cgroup/affinity-aware where the OS exposes it)."""
+    try:
+        return len(os.sched_getaffinity(0))
+    except AttributeError:  # non-Linux
+        return os.cpu_count() or 1
+
+
+def relax_cpu_collective_timeouts(
+    warn_s: int = 120, terminate_s: int = 900
+) -> None:
+    """Raise XLA:CPU's collective-rendezvous watchdogs (default 20 s warn /
+    40 s TERMINATE-the-process) via XLA_FLAGS.  On an oversubscribed host —
+    N virtual devices time-slicing a core or two, exactly the CI/virtual-
+    mesh topology — a long first-compile or a heavy step can keep one
+    device thread away from a rendezvous past 40 s and XLA kills the
+    process mid-training.  Call BEFORE the first jax backend init; no-op
+    for flags the caller already set explicitly."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    add = []
+    if "xla_cpu_collective_call_warn_stuck_timeout_seconds" not in flags:
+        add.append(
+            f"--xla_cpu_collective_call_warn_stuck_timeout_seconds={warn_s}"
+        )
+    if "xla_cpu_collective_call_terminate_timeout_seconds" not in flags:
+        add.append(
+            f"--xla_cpu_collective_call_terminate_timeout_seconds={terminate_s}"
+        )
+    if add:
+        os.environ["XLA_FLAGS"] = " ".join([flags] + add).strip()
+
+
 def sanitize_backend() -> None:
     requested = os.environ.get("JAX_PLATFORMS", "")
     if any(p in requested for p in _TUNNEL_PLATFORMS):

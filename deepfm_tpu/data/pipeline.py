@@ -125,6 +125,7 @@ def ctr_batches_from_sources(
     permute_vocab: int = 0,
     verify_crc: bool | None = None,
     skip_counter: list[int] | None = None,
+    parallel_readers: int = 1,
 ) -> Iterator[dict]:
     """Source files/FIFOs -> decoded batches, via the C++ reader when built.
 
@@ -132,6 +133,10 @@ def ctr_batches_from_sources(
     sharding + Example decode and hands back whole numpy batches; the
     pure-Python chain (record_stream -> batched_ctr_batches) is the portable
     fallback with identical semantics (tests assert parity).
+
+    ``parallel_readers > 1`` with multiple sources streams the sources
+    through concurrent per-source C++ readers (data/parallel_ingest.py) —
+    same batches in the same order, decoded on several cores.
 
     ``verify_crc=None`` means "verify when it's cheap": the native reader
     checks (hardware crc32c is ~free), the Python fallback skips (software
@@ -145,16 +150,41 @@ def ctr_batches_from_sources(
     if native.available():
         from ..parallel.embedding import permute_ids
 
-        reader = native.NativeCtrReader(
-            sources,
-            batch_size=batch_size,
-            field_size=field_size,
-            shard_n=shard_n,
-            shard_i=shard_i,
-            drop_remainder=drop_remainder,
-            verify=True if verify_crc is None else verify_crc,
-            skip_counter=skip_counter,
-        )
+        # threads only help with cores to run them: cap at host CPUs so a
+        # 1-core host transparently takes the sequential path (thread
+        # hand-off costs ~15% there for zero parallelism).
+        # DEEPFM_FORCE_PARALLEL_READERS=1 skips the cap (tests/benches).
+        from ..core.platform import host_cpu_count
+
+        if os.environ.get("DEEPFM_FORCE_PARALLEL_READERS"):
+            threads = parallel_readers
+        else:
+            threads = min(parallel_readers, host_cpu_count())
+        if threads > 1 and len(sources) > 1:
+            from .parallel_ingest import parallel_ctr_batches
+
+            reader = parallel_ctr_batches(
+                sources,
+                batch_size=batch_size,
+                field_size=field_size,
+                shard_n=shard_n,
+                shard_i=shard_i,
+                drop_remainder=drop_remainder,
+                verify=True if verify_crc is None else verify_crc,
+                skip_counter=skip_counter,
+                num_threads=threads,
+            )
+        else:
+            reader = native.NativeCtrReader(
+                sources,
+                batch_size=batch_size,
+                field_size=field_size,
+                shard_n=shard_n,
+                shard_i=shard_i,
+                drop_remainder=drop_remainder,
+                verify=True if verify_crc is None else verify_crc,
+                skip_counter=skip_counter,
+            )
         for b in reader:
             if permute_vocab:
                 b["feat_ids"] = permute_ids(b["feat_ids"], permute_vocab, True)
@@ -354,6 +384,7 @@ def make_input_pipeline(
                 drop_remainder=cfg.drop_remainder,
                 permute_vocab=permute_vocab,
                 skip_counter=skip_counter,
+                parallel_readers=cfg.parallel_readers,
             ),
             epoch,
         )

@@ -23,7 +23,7 @@ import json
 import sys
 
 from ..core.config import Config
-from ..core.platform import sanitize_backend
+from ..core.platform import relax_cpu_collective_timeouts, sanitize_backend
 
 
 def _coerce(value: str):
@@ -139,6 +139,7 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps(cfg.to_dict(), indent=2))
         return 0
     sanitize_backend()
+    relax_cpu_collective_timeouts()
     from ..checkpoint import maybe_clear
     from ..train.loop import run_task
     from ..utils import MetricLogger

@@ -63,6 +63,19 @@ def setup(cfg: Config) -> SPMDContext:
     return make_context(cfg, mesh)
 
 
+def _cpu_serialize_dispatch() -> bool:
+    """True on the CPU backend, where sharded dispatch must be serialized.
+
+    XLA:CPU runs every virtual device's thunks on one shared executor pool;
+    with async dispatch two in-flight sharded programs can interleave so the
+    second program's thunks occupy the threads the first program's
+    collective rendezvous is waiting for — a deadlock (observed as
+    `rendezvous.cc` watchdog kills on a 1-core host).  Blocking each step
+    keeps at most one N-participant program in flight.  Virtual CPU meshes
+    are a CI/test construct; TPU dispatch stays fully pipelined."""
+    return jax.default_backend() == "cpu"
+
+
 def _train_batches(
     cfg: Config, ctx: SPMDContext, *, skip_batches: int = 0
 ) -> DevicePrefetcher:
@@ -189,6 +202,8 @@ def run_eval(cfg: Config, ctx: SPMDContext, state: TrainState, log: MetricLogger
         )
         sb = shard_batch(ctx, batch)
         auc_state, m = eval_step(state, auc_state, sb)
+        # float(m["loss"]) below blocks per batch, which also keeps CPU-mesh
+        # dispatch serialized (see _cpu_serialize_dispatch)
         loss_sum += float(m["loss"]) * true_count
         counts += true_count
     result = {
@@ -230,10 +245,13 @@ def run_train(cfg: Config) -> TrainState:
     eval_enabled = _has_eval_source(cfg) and cfg.run.eval_throttle_secs > 0
     t_start = time.time()
     next_eval = t_start + max(cfg.run.eval_start_delay_secs, cfg.run.eval_throttle_secs)
+    cpu_serial = _cpu_serialize_dispatch()
     with profile_cm, guard, _train_batches(cfg, ctx, skip_batches=step) as batches:
         for batch in batches:
             batch_size = int(batch["label"].shape[0])
             state, metrics = train_step(state, batch)
+            if cpu_serial:
+                jax.block_until_ready(metrics)
             step += 1
             log.step(step, batch_size, {k: v for k, v in metrics.items()
                                         if k != "loss_per_shard"})

@@ -15,9 +15,16 @@ if not os.environ.get("DEEPFM_TEST_TPU"):
     os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8"
-        ).strip()
+        flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+    # 8 virtual devices time-slice few (often 1) CI cores: raise XLA:CPU's
+    # 20s-warn/40s-KILL collective rendezvous watchdogs, which heavyweight
+    # compiles or steps can trip on an oversubscribed host
+    if "xla_cpu_collective_call_terminate_timeout_seconds" not in flags:
+        flags += (
+            " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+            " --xla_cpu_collective_call_terminate_timeout_seconds=900"
+        )
+    os.environ["XLA_FLAGS"] = flags
     # The environment's sitecustomize registers an experimental TPU-tunnel
     # PJRT plugin ("axon") at interpreter start and hooks jax's backend
     # lookup so that even JAX_PLATFORMS=cpu triggers its (blocking) device
