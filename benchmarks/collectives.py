@@ -25,9 +25,13 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from deepfm_tpu.core.platform import sanitize_backend  # noqa: E402
+from deepfm_tpu.core.platform import (  # noqa: E402
+    relax_cpu_collective_timeouts,
+    sanitize_backend,
+)
 
 sanitize_backend()
+relax_cpu_collective_timeouts()
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
@@ -43,7 +47,11 @@ def _time(fn, *args, iters=20):
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
+        # block per iteration: >1 in-flight sharded program can deadlock
+        # XLA:CPU's shared thunk executor at a collective rendezvous
+        # (train/loop.py _cpu_serialize_dispatch); on TPU this only adds
+        # one host sync per iteration to an already-measured dispatch
+        jax.block_until_ready(out)
     return (time.perf_counter() - t0) / iters
 
 

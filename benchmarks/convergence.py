@@ -64,6 +64,83 @@ def load_split():
     return subset(tr), subset(ev)
 
 
+def make_synthetic(records: int, *, seed: int = 0, vocab: int = 117_581,
+                   fields: int = 39, teacher_k: int = 8):
+    """Criteo-Kaggle-shaped synthetic CTR with PLANTED interaction structure.
+
+    Shape mirrors the real data (13 numeric + 26 categorical fields, ids in
+    one global [0, vocab) space, per-field Zipf marginals with wildly uneven
+    field vocabularies — the hot-row skew that stresses sharding).  Labels
+    come from a hidden TEACHER FM (first-order weights + rank-``teacher_k``
+    pairwise interactions + calibrated bias, sampled once from ``seed``):
+    ``y ~ Bernoulli(sigmoid(teacher_logit))``.  A student that learns the
+    planted structure approaches the teacher's own (Bayes-optimal) AUC,
+    which is returned as the ceiling; a student that only memorizes cannot
+    — on 5M records one epoch never revisits a (rare-id) row pattern.
+    """
+    rng = np.random.default_rng(seed)
+    num_numeric = 13
+    n_cat = fields - num_numeric
+    remaining = vocab - num_numeric - 1
+    # per-field vocab sizes: log-uniform (some tiny, some huge), packed into
+    # the global id space after the numeric ids 1..13
+    raw = np.exp(rng.uniform(np.log(10.0), np.log(remaining / 2.0), n_cat))
+    sizes = np.maximum(2, (raw / raw.sum() * remaining).astype(np.int64))
+    while sizes.sum() > remaining:  # rounding overflow: shrink the largest
+        sizes[np.argmax(sizes)] -= sizes.sum() - remaining
+    offsets = num_numeric + 1 + np.concatenate([[0], np.cumsum(sizes)[:-1]])
+
+    ids = np.empty((records, fields), np.int64)
+    vals = np.empty((records, fields), np.float32)
+    ids[:, :num_numeric] = np.arange(1, num_numeric + 1)
+    vals[:, :num_numeric] = rng.random((records, num_numeric), np.float32)
+    for f in range(n_cat):
+        z = (rng.zipf(1.2, records) - 1) % sizes[f]
+        ids[:, num_numeric + f] = offsets[f] + z
+    vals[:, num_numeric:] = 1.0
+
+    # hidden teacher FM: w gathers + rank-k FM identity, chunked
+    w = (rng.normal(0.0, 0.35, vocab)).astype(np.float32)
+    vt = (rng.normal(0.0, 1.0, (vocab, teacher_k)) * 0.35).astype(np.float32)
+    logits = np.empty(records, np.float32)
+    for i in range(0, records, 200_000):
+        s = slice(i, min(records, i + 200_000))
+        e = vt[ids[s]] * vals[s][:, :, None]          # [b, F, k]
+        sv = e.sum(axis=1)
+        fm2 = 0.5 * (np.square(sv) - np.square(e).sum(axis=1)).sum(axis=1)
+        fm1 = (w[ids[s]] * vals[s]).sum(axis=1)
+        logits[s] = fm1 + fm2
+    # calibrate the bias for ~25% positives (reference-like CTR base rate)
+    lo, hi = -20.0, 20.0
+    for _ in range(40):
+        b0 = 0.5 * (lo + hi)
+        if (1.0 / (1.0 + np.exp(-(logits + b0)))).mean() > 0.25:
+            hi = b0
+        else:
+            lo = b0
+    p = 1.0 / (1.0 + np.exp(-(logits + b0)))
+    labels = (rng.random(records) < p).astype(np.float32)
+
+    from deepfm_tpu.data.pipeline import InMemoryDataset
+    from deepfm_tpu.ops.auc import exact_auc
+
+    ev = np.arange(records) % 25 == 0     # 4% deterministic holdout
+    tr = ~ev
+    teacher_auc = float(exact_auc(labels[ev], p[ev]))
+    return (
+        InMemoryDataset(ids[tr], vals[tr], labels[tr]),
+        InMemoryDataset(ids[ev], vals[ev], labels[ev]),
+        {
+            "teacher_bayes_auc_eval": round(teacher_auc, 5),
+            "label_mean": round(float(labels.mean()), 5),
+            "field_vocab_min": int(sizes.min()),
+            "field_vocab_max": int(sizes.max()),
+            "teacher_k": teacher_k,
+            "gen_seed": seed,
+        },
+    )
+
+
 def flagship_cfg(batch_size: int, *, lazy: bool = False):
     from deepfm_tpu.core.config import Config
 
@@ -182,6 +259,7 @@ def run_spmd(train_ds, eval_ds, *, epochs, batch_size, dp, mp, eval_every):
             batch_size, shuffle=True, seed=epoch, drop_remainder=True
         ):
             state, m = step_fn(state, shard_batch(ctx, batch))
+            jax.block_until_ready(m["ce"])  # CPU-mesh dispatch serialization
             step += 1
         if epoch % eval_every == 0 or epoch == epochs:
 
@@ -216,14 +294,180 @@ def run_spmd(train_ds, eval_ds, *, epochs, batch_size, dp, mp, eval_every):
     return curve, round(time.time() - t0, 1)
 
 
+def run_matched_steps(
+    train_ds, eval_ds, *, variant: str, batch_size: int, seed: int,
+    eval_every_steps: int, train_probe_rows: int = 200_000,
+):
+    """One epoch over ``train_ds`` at matched step count for every variant
+    (dense / lazy / dp8 / dp4_mp2), identical batch order (shuffle seed 1),
+    differing only in init seed and execution path.  Evals at fixed step
+    milestones measure eval AUC/CE AND train-probe AUC (a fixed train
+    subsample — the no-overfit evidence)."""
+    lazy = variant == "lazy"
+    spmd = variant.startswith("dp")
+    cfg = flagship_cfg(batch_size, lazy=lazy).with_overrides(
+        run={"seed": seed}
+    )
+    if spmd:
+        from deepfm_tpu.core.config import MeshConfig
+        from deepfm_tpu.parallel import (
+            build_mesh, create_spmd_state, make_context,
+            make_spmd_predict_step, make_spmd_train_step, shard_batch,
+        )
+
+        dp, mp = {"dp8": (8, 1), "dp4_mp2": (4, 2)}[variant]
+        cfg = cfg.with_overrides(mesh={"data_parallel": dp, "model_parallel": mp})
+        mesh = build_mesh(MeshConfig(data_parallel=dp, model_parallel=mp))
+        ctx = make_context(cfg, mesh)
+        state = create_spmd_state(ctx)
+        step_fn = make_spmd_train_step(ctx)
+        predict_fn = make_spmd_predict_step(ctx)
+
+        def predict(ids, vals):
+            b = ids.shape[0]
+            pad = (-b) % dp
+            if pad:
+                ids = np.concatenate([ids, np.repeat(ids[-1:], pad, 0)])
+                vals = np.concatenate([vals, np.repeat(vals[-1:], pad, 0)])
+            sb = shard_batch(ctx, {
+                "feat_ids": ids, "feat_vals": vals,
+                "label": np.zeros(ids.shape[0], np.float32),
+            })
+            return np.asarray(jax.device_get(predict_fn(state, sb)))[:b]
+
+        def do_step(batch):
+            nonlocal state
+            state, m = step_fn(state, shard_batch(ctx, batch))
+            # serialize CPU-mesh dispatch: two in-flight sharded programs
+            # can deadlock XLA:CPU's shared executor (train/loop.py
+            # _cpu_serialize_dispatch)
+            jax.block_until_ready(m["ce"])
+            return m
+    else:
+        from deepfm_tpu.train import create_train_state, make_train_step
+        from deepfm_tpu.train.step import make_predict_step
+
+        state = create_train_state(cfg)
+        step_fn = jax.jit(make_train_step(cfg), donate_argnums=(0,))
+        predict_raw = jax.jit(make_predict_step(cfg))
+
+        def predict(ids, vals):
+            return predict_raw(state, {"feat_ids": ids, "feat_vals": vals})
+
+        def do_step(batch):
+            nonlocal state
+            state, m = step_fn(state, batch)
+            return m
+
+    from deepfm_tpu.data.pipeline import InMemoryDataset
+
+    n_probe = min(train_probe_rows, len(train_ds))
+    probe = InMemoryDataset(
+        train_ds.feat_ids[:n_probe], train_ds.feat_vals[:n_probe],
+        train_ds.label[:n_probe],
+    )
+    curve = []
+    t0 = time.time()
+    step = 0
+    m = None
+    for batch in train_ds.batches(
+        batch_size, shuffle=True, seed=1, drop_remainder=True
+    ):
+        m = do_step(batch)
+        step += 1
+        if step % eval_every_steps == 0:
+            ev = evaluate(predict, eval_ds)
+            tr = evaluate(predict, probe)
+            curve.append({
+                "step": step,
+                "train_ce": round(float(m["ce"]), 5),
+                "eval_auc": round(ev["auc_streaming"], 5),
+                "eval_auc_exact": round(ev["auc_exact"], 5),
+                "eval_ce": round(ev["ce"], 5),
+                "train_probe_auc": round(tr["auc_streaming"], 5),
+                "train_probe_ce": round(tr["ce"], 5),
+            })
+            print(json.dumps({"variant": variant, "seed": seed, **curve[-1]}),
+                  file=sys.stderr)
+    if not curve or curve[-1]["step"] != step:
+        ev = evaluate(predict, eval_ds)
+        tr = evaluate(predict, probe)
+        curve.append({
+            "step": step,
+            "train_ce": round(float(m["ce"]), 5),
+            "eval_auc": round(ev["auc_streaming"], 5),
+            "eval_auc_exact": round(ev["auc_exact"], 5),
+            "eval_ce": round(ev["ce"], 5),
+            "train_probe_auc": round(tr["auc_streaming"], 5),
+            "train_probe_ce": round(tr["ce"], 5),
+        })
+        print(json.dumps({"variant": variant, "seed": seed, **curve[-1]}),
+              file=sys.stderr)
+    return curve, round(time.time() - t0, 1)
+
+
+def run_synthetic(args) -> None:
+    """VERDICT r02 #2: convergence evidence that can't be dismissed as
+    overfit noise — >=5M Criteo-shaped records with planted teacher-FM
+    structure, all four variants at matched steps, multi-seed error bars on
+    the dense path."""
+    t0 = time.time()
+    train_ds, eval_ds, gen_meta = make_synthetic(args.records, seed=7)
+    meta = {
+        "dataset": f"synthetic teacher-FM, {args.records} records",
+        "train_records": len(train_ds),
+        "eval_records": len(eval_ds),
+        "generation_secs": round(time.time() - t0, 1),
+        "batch_size": args.batch_size,
+        "platform": jax.devices()[0].platform,
+        "device_count": jax.device_count(),
+        **gen_meta,
+    }
+    print(json.dumps(meta), file=sys.stderr)
+    kw = dict(batch_size=args.batch_size,
+              eval_every_steps=args.eval_every_steps)
+    results = {}
+    for s in range(args.seeds):
+        curve, secs = run_matched_steps(
+            train_ds, eval_ds, variant="dense", seed=s, **kw
+        )
+        results[f"dense_seed{s}"] = {"curve": curve, "seconds": secs}
+    for variant in ("lazy", "dp8", "dp4_mp2"):
+        if variant.startswith("dp") and jax.device_count() < 8:
+            continue
+        curve, secs = run_matched_steps(
+            train_ds, eval_ds, variant=variant, seed=0, **kw
+        )
+        results[variant] = {"curve": curve, "seconds": secs}
+
+    payload = {"meta": meta, "results": results}
+    os.makedirs(args.out, exist_ok=True)
+    with open(os.path.join(args.out, "convergence_synthetic.json"), "w") as f:
+        json.dump(payload, f, indent=1)
+    write_md(args.out)
+    finals = {k: r["curve"][-1]["eval_auc"] for k, r in results.items()}
+    print(json.dumps({"teacher_auc": gen_meta["teacher_bayes_auc_eval"],
+                      "final_eval_auc": finals}))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
+    ap.add_argument("--dataset", choices=("bundled", "synthetic"),
+                    default="bundled")
+    ap.add_argument("--records", type=int, default=5_000_000)
+    ap.add_argument("--seeds", type=int, default=3)
+    ap.add_argument("--eval-every-steps", type=int, default=1200)
     ap.add_argument("--epochs", type=int, default=60)
     ap.add_argument("--batch-size", type=int, default=512)
     ap.add_argument("--eval-every", type=int, default=10)
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "docs"))
     args = ap.parse_args()
+    if args.dataset == "synthetic":
+        if args.batch_size == 512:
+            args.batch_size = 1024  # flagship batch for the 5M run
+        run_synthetic(args)
+        return
 
     if not os.path.exists(VAL_TFRECORDS):
         print(json.dumps({"error": "reference val.tfrecords not available"}))
@@ -268,59 +512,129 @@ def main() -> None:
     json_path = os.path.join(args.out, "convergence_results.json")
     with open(json_path, "w") as f:
         json.dump(payload, f, indent=1)
-
-    lines = [
-        "# Convergence / AUC parity evidence",
-        "",
-        "Generated by `python benchmarks/convergence.py` — flagship config "
-        "(reference notebook cell 4: V=117,581, F=39, K=32, deep 128/64/32, "
-        "dropout keep 0.5, Adam 5e-4, l2 1e-4) trained on a deterministic "
-        "80/20 split of the bundled real data "
-        "`/root/reference/data/val.tfrecords` "
-        f"({meta['train_records']} train / {meta['eval_records']} held-out "
-        "records).  The reference's eval metric is streaming AUC (ps:282); "
-        "it publishes no value, so this is the self-generated baseline "
-        "curve BASELINE.md calls for.",
-        "",
-        f"Platform: {meta['platform']} x{meta['device_count']}, "
-        f"batch {meta['batch_size']}, {meta['epochs']} epochs.",
-        "",
-        "| variant | final eval AUC | exact-AUC cross-check | eval CE | "
-        "best eval AUC | seconds |",
-        "|---|---|---|---|---|---|",
-    ]
-    for name, r in results.items():
-        last = r["curve"][-1]
-        best = max(c["eval_auc"] for c in r["curve"])
-        lines.append(
-            f"| {name} | {last['eval_auc']:.4f} | "
-            f"{last['eval_auc_exact']:.4f} | {last['eval_ce']:.4f} | "
-            f"{best:.4f} | {r['seconds']} |"
-        )
-    lines += [
-        "",
-        "Reading the table:",
-        "",
-        "- **sync-vs-async convergence** (PARITY.md §2c): `spmd_dp8` is the "
-        "sync-SPMD replacement for the reference's async PS path; its AUC "
-        "matching `single_dense` is the convergence-parity argument, now "
-        "backed by numbers.",
-        "- **row-sharded tables** (`spmd_dp4_mp2`) and **lazy Adam** "
-        "(`lazy_adam`) must match too — the PS-capability and "
-        "sparse-update trajectories.",
-        "- **streaming vs exact AUC**: the bucketed tf.metrics.auc-"
-        "compatible metric (200 thresholds) agrees with the Mann-Whitney "
-        "exact AUC to ~1e-3 while predictions are calibrated; once the "
-        "model overfits and probabilities saturate toward 0/1, the fixed "
-        "threshold grid coarsens and the bucketed value drifts low — the "
-        "same artifact tf.metrics.auc(num_thresholds=200) exhibits, which "
-        "is itself part of the parity story (ops/auc.py).",
-        "",
-        "Full curves: `docs/convergence_results.json`.",
-    ]
-    with open(os.path.join(args.out, "CONVERGENCE.md"), "w") as f:
-        f.write("\n".join(lines) + "\n")
+    write_md(args.out)
     print(json.dumps({k: r["curve"][-1] for k, r in results.items()}))
+
+
+def write_md(out_dir: str) -> None:
+    """Regenerate docs/CONVERGENCE.md from whichever result JSONs exist:
+    the 5M synthetic matched-steps study (primary — multi-seed error bars,
+    teacher ceiling, no-overfit probes) and the bundled-real-data study
+    (secondary — small but real Criteo records)."""
+    lines = ["# Convergence / AUC parity evidence", ""]
+
+    syn_path = os.path.join(out_dir, "convergence_synthetic.json")
+    if os.path.exists(syn_path):
+        with open(syn_path) as f:
+            syn = json.load(f)
+        meta, results = syn["meta"], syn["results"]
+        dense_finals = [
+            r["curve"][-1]["eval_auc"]
+            for k, r in results.items() if k.startswith("dense_seed")
+        ]
+        spread = (max(dense_finals) - min(dense_finals)) if dense_finals else 0
+        n_total = meta["train_records"] + meta["eval_records"]
+        n_label = (
+            f"{n_total / 1e6:.0f}M" if n_total >= 1e6 else f"{n_total:,}"
+        )
+        probe_gap = max(
+            (r["curve"][-1]["train_probe_auc"] - r["curve"][-1]["eval_auc"])
+            for r in results.values()
+        )
+        lines += [
+            f"## 1. {n_label}-record synthetic study (matched steps, "
+            "multi-seed)",
+            "",
+            f"`python benchmarks/convergence.py --dataset synthetic` — "
+            f"{meta['dataset']}: Criteo-shaped fields (13 numeric + 26 "
+            f"categorical, per-field Zipf marginals, field vocabularies "
+            f"{meta['field_vocab_min']}-{meta['field_vocab_max']}), labels "
+            f"from a hidden rank-{meta['teacher_k']} teacher FM.  "
+            f"{meta['train_records']} train / {meta['eval_records']} "
+            f"held-out records, batch {meta['batch_size']}, ONE epoch — "
+            f"every variant sees the identical batch sequence, so rows "
+            f"differ only by execution path and init seed.  The teacher's "
+            f"own (Bayes-optimal) eval AUC is "
+            f"**{meta['teacher_bayes_auc_eval']:.4f}** — the ceiling.",
+            "",
+            "| variant | final eval AUC | exact cross-check | eval CE | "
+            "train-probe AUC | seconds |",
+            "|---|---|---|---|---|---|",
+        ]
+        for name, r in results.items():
+            last = r["curve"][-1]
+            lines.append(
+                f"| {name} | {last['eval_auc']:.4f} | "
+                f"{last['eval_auc_exact']:.4f} | {last['eval_ce']:.4f} | "
+                f"{last['train_probe_auc']:.4f} | {r['seconds']} |"
+            )
+        lines += [
+            "",
+            f"- **Seed variance (dense, {len(dense_finals)} seeds): "
+            f"final eval AUC spread {spread:.4f}** — the yardstick for "
+            f"calling cross-variant differences noise or real.",
+            f"- **Overfit check**: the largest train-probe-minus-eval AUC "
+            f"gap across variants is **{probe_gap:+.4f}** (one epoch over "
+            f"{n_label} records; rare-id rows are never revisited).  "
+            "Compare the r02 critique of the bundled study: train 0.99 / "
+            "eval 0.66 on 8k records.",
+            "- **sync-vs-async** (PARITY.md §2c): `dp8` is the sync-SPMD "
+            "replacement for the reference's async PS path; matching the "
+            "dense seeds within their spread at matched steps is the "
+            "convergence-parity argument.",
+            "- `dp4_mp2` exercises row-sharded tables (the PS capability); "
+            "`lazy` the touched-rows-only Adam trajectory (different L2 "
+            "semantics: touched rows only, train/lazy.py).",
+            "",
+            "Full curves: `docs/convergence_synthetic.json`.",
+            "",
+        ]
+
+    res_path = os.path.join(out_dir, "convergence_results.json")
+    if os.path.exists(res_path):
+        with open(res_path) as f:
+            bundled = json.load(f)
+        meta, results = bundled["meta"], bundled["results"]
+        lines += [
+            "## 2. Bundled real-data study (8k train / 2k holdout)",
+            "",
+            "`python benchmarks/convergence.py` — flagship config "
+            "(reference notebook cell 4: V=117,581, F=39, K=32, deep "
+            "128/64/32, dropout keep 0.5, Adam 5e-4, l2 1e-4) on a "
+            "deterministic 80/20 split of the bundled real "
+            "`/root/reference/data/val.tfrecords` "
+            f"({meta['train_records']} train / {meta['eval_records']} "
+            f"held-out records), {meta['epochs']} epochs, batch "
+            f"{meta['batch_size']}.  Small but REAL Criteo records; the "
+            "model overfits by design (the 5M study above is the "
+            "statistically meaningful one).",
+            "",
+            "| variant | final eval AUC | exact cross-check | eval CE | "
+            "best eval AUC | seconds |",
+            "|---|---|---|---|---|---|",
+        ]
+        for name, r in results.items():
+            last = r["curve"][-1]
+            best = max(c["eval_auc"] for c in r["curve"])
+            lines.append(
+                f"| {name} | {last['eval_auc']:.4f} | "
+                f"{last['eval_auc_exact']:.4f} | {last['eval_ce']:.4f} | "
+                f"{best:.4f} | {r['seconds']} |"
+            )
+        lines += [
+            "",
+            "- **streaming vs exact AUC**: the bucketed tf.metrics.auc-"
+            "compatible metric (200 thresholds) agrees with the "
+            "Mann-Whitney exact AUC to ~1e-3 while predictions are "
+            "calibrated; once probabilities saturate the fixed grid "
+            "coarsens and the bucketed value drifts low — the same "
+            "artifact tf.metrics.auc(num_thresholds=200) exhibits "
+            "(ops/auc.py).",
+            "",
+            "Full curves: `docs/convergence_results.json`.",
+        ]
+    with open(os.path.join(out_dir, "CONVERGENCE.md"), "w") as f:
+        f.write("\n".join(lines) + "\n")
 
 
 if __name__ == "__main__":

@@ -154,13 +154,18 @@ def ctr_batches_from_sources(
         # 1-core host transparently takes the sequential path (thread
         # hand-off costs ~15% there for zero parallelism).
         # DEEPFM_FORCE_PARALLEL_READERS=1 skips the cap (tests/benches).
+        # Record-level round-robin sharding (shard_n > 1) also stays
+        # sequential: the C++ reader skips DECODING other shards' records,
+        # while the parallel merger decodes everything and strides after —
+        # shard_n x the decode work, a regression for exactly the
+        # multi-host file-mode runs that hit this branch.
         from ..core.platform import host_cpu_count
 
         if os.environ.get("DEEPFM_FORCE_PARALLEL_READERS"):
             threads = parallel_readers
         else:
             threads = min(parallel_readers, host_cpu_count())
-        if threads > 1 and len(sources) > 1:
+        if threads > 1 and len(sources) > 1 and shard_n == 1:
             from .parallel_ingest import parallel_ctr_batches
 
             reader = parallel_ctr_batches(

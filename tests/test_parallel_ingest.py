@@ -123,19 +123,42 @@ def test_skip_counter_parity(tmp_path):
 
 def test_pipeline_dispatch_parallel(tmp_path, monkeypatch):
     """ctr_batches_from_sources(parallel_readers=4) is bit-identical to the
-    sequential dispatch, shard matrix included.  (The env var skips the
-    cores cap so the parallel path engages even on a 1-core CI host.)"""
+    sequential dispatch.  (The env var skips the cores cap so the parallel
+    path engages even on a 1-core CI host.)"""
     monkeypatch.setenv("DEEPFM_FORCE_PARALLEL_READERS", "1")
     paths, _ = _write_shards(tmp_path, [50, 50, 28, 44], seed=3)
-    kw = dict(
-        batch_size=10,
-        field_size=FIELD,
-        decision=ShardDecision(num_shards=2, shard_index=1),
-        drop_remainder=False,
-    )
+    kw = dict(batch_size=10, field_size=FIELD, drop_remainder=False)
     seq = list(ctr_batches_from_sources(paths, **kw))
     par = list(ctr_batches_from_sources(paths, parallel_readers=4, **kw))
     _assert_same(par, seq)
+
+
+def test_pipeline_dispatch_stays_sequential_when_record_sharded(
+    tmp_path, monkeypatch
+):
+    """With record-level round-robin sharding the dispatch must keep the
+    sequential C++ reader (which skips decoding other shards' records) —
+    the parallel merger would decode everything and stride after."""
+    monkeypatch.setenv("DEEPFM_FORCE_PARALLEL_READERS", "1")
+
+    def boom(*a, **k):
+        raise AssertionError("parallel path must not engage when shard_n > 1")
+
+    import deepfm_tpu.data.parallel_ingest as pi
+
+    monkeypatch.setattr(pi, "parallel_ctr_batches", boom)
+    paths, _ = _write_shards(tmp_path, [40, 40], seed=8)
+    batches = list(
+        ctr_batches_from_sources(
+            paths,
+            batch_size=10,
+            field_size=FIELD,
+            decision=ShardDecision(num_shards=2, shard_index=0),
+            drop_remainder=False,
+            parallel_readers=4,
+        )
+    )
+    assert sum(len(b["label"]) for b in batches) == 40
 
 
 def test_fifo_sources(tmp_path):
