@@ -144,7 +144,10 @@ def main() -> None:
     # ---- 1. sharded init ----------------------------------------------
     t0 = time.perf_counter()
     cfg_a = make_cfg(sdp, smp)
-    mesh_a = build_mesh(MeshConfig(data_parallel=sdp, model_parallel=smp))
+    mesh_a = build_mesh(
+        MeshConfig(data_parallel=sdp, model_parallel=smp),
+        devices=jax.devices()[: sdp * smp],
+    )
     ctx_a = make_context(cfg_a, mesh_a)
     state = create_spmd_state(ctx_a)
     jax.block_until_ready(state.params["fm_v"])
@@ -223,7 +226,10 @@ def main() -> None:
     result["rss_after_drop_gb"] = rss_gb()
 
     cfg_b = make_cfg(ddp, dmp)
-    mesh_b = build_mesh(MeshConfig(data_parallel=ddp, model_parallel=dmp))
+    mesh_b = build_mesh(
+        MeshConfig(data_parallel=ddp, model_parallel=dmp),
+        devices=jax.devices()[: ddp * dmp],
+    )
     ctx_b = make_context(cfg_b, mesh_b)
     t0 = time.perf_counter()
     restored = restore_resharded(ckpt, ctx_b)

@@ -24,4 +24,9 @@ echo "== end-to-end ingest on TPU =="
 JAX_PLATFORMS=axon timeout 1800 \
     python benchmarks/ingest.py --records 200000 --persist || status=1
 
+echo "== 10M-row lazy table on the real chip (HBM gather/scatter path) =="
+DEEPFM_LV_PLATFORM=axon timeout 1800 \
+    python benchmarks/large_vocab.py --rows 10000000 --steps 20 \
+    --src-mesh 1,1 --dst-mesh 1,1 --persist || status=1
+
 exit $status

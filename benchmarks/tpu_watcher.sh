@@ -34,8 +34,8 @@ commit_with_retry() {
     # silently reverting it.
     local paths=() p branch old tree new idx
     for p in BENCH_TPU.json docs/BENCH_COLLECTIVES.json \
-        docs/BENCH_INGEST.json docs/TPU_WATCHER_LOG.jsonl \
-        docs/TPU_SESSION_OUT.log; do
+        docs/BENCH_INGEST.json docs/BENCH_LARGE_VOCAB.json \
+        docs/TPU_WATCHER_LOG.jsonl docs/TPU_SESSION_OUT.log; do
         [[ -e $p ]] && paths+=("$p")
     done
     if ! git status --porcelain -- "${paths[@]}" | grep -q .; then
