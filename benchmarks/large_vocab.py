@@ -100,7 +100,7 @@ def main() -> None:
     result: dict = {
         "metric": "large_vocab_execution",
         "platform": devices[0].platform,
-        "devices": len(devices),
+        "devices_available": len(devices),
         "rows": args.rows,
         "k": args.k,
         "batch_size": BATCH,
@@ -140,6 +140,7 @@ def main() -> None:
     sdp, smp = (int(x) for x in args.src_mesh.split(","))
     ddp, dmp = (int(x) for x in args.dst_mesh.split(","))
     result["src_mesh"], result["dst_mesh"] = [sdp, smp], [ddp, dmp]
+    result["devices"] = max(sdp * smp, ddp * dmp)  # devices the meshes use
 
     # ---- 1. sharded init ----------------------------------------------
     t0 = time.perf_counter()

@@ -4,3 +4,4 @@ from .export import (  # noqa: F401
     load_servable,
     write_predictions,
 )
+from .server import Scorer, score_stdin, serve_forever  # noqa: F401

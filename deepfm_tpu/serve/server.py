@@ -1,0 +1,235 @@
+"""Online scoring — the TF-Serving role behind the reference's export.
+
+The reference's serving story ends at ``export_savedmodel`` (ps:535-551):
+the SavedModel is handed to TF Serving, which exposes a REST predict
+endpoint.  This module is that last mile for the framework's servable
+artifact, with zero extra dependencies:
+
+* **REST mode** (default): an ``http.server`` endpoint speaking the TF
+  Serving REST request/response shape —
+
+      POST /v1/models/<name>:predict
+      {"instances": [{"feat_ids": [...F ints], "feat_vals": [...F floats]},
+                     ...]}
+      -> {"predictions": [p0, p1, ...]}
+
+  so a client written against TF Serving's CTR signature works unchanged
+  (modulo host/port).  ``GET /v1/models/<name>`` returns a status document.
+
+* **stdin mode** (``--stdin``): scores libsvm lines (``label id:val ...`` —
+  label ignored) or JSON-object lines to one probability per line, for
+  shell pipelines and smoke tests.
+
+Requests are scored through the jitted servable ``predict`` closure
+(serve/export.py); inputs are padded to a fixed batch size so XLA compiles
+ONE executable instead of one per request size.
+
+    python -m deepfm_tpu.serve.server --servable /path/servable --port 8501
+    cat batch.libsvm | python -m deepfm_tpu.serve.server --servable D --stdin
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+import numpy as np
+
+
+class Scorer:
+    """Fixed-batch wrapper over the servable predict closure."""
+
+    def __init__(self, predict: Callable, field_size: int, batch_size: int = 256):
+        self._predict = predict
+        self._fields = field_size
+        self._batch = batch_size
+        self._lock = threading.Lock()  # jit dispatch is cheap; keep it simple
+
+    def score(self, ids: np.ndarray, vals: np.ndarray) -> np.ndarray:
+        """ids/vals [N, F] -> prob [N], padded through the fixed batch."""
+        if ids.ndim != 2 or ids.shape[1] != self._fields:
+            raise ValueError(
+                f"expected [N, {self._fields}] features, got {ids.shape}"
+            )
+        n = ids.shape[0]
+        out = np.empty(n, np.float32)
+        with self._lock:
+            for i in range(0, n, self._batch):
+                chunk_ids = ids[i : i + self._batch]
+                chunk_vals = vals[i : i + self._batch]
+                b = chunk_ids.shape[0]
+                pad = self._batch - b
+                if pad:
+                    chunk_ids = np.concatenate(
+                        [chunk_ids, np.zeros((pad, self._fields), ids.dtype)]
+                    )
+                    chunk_vals = np.concatenate(
+                        [chunk_vals, np.zeros((pad, self._fields), vals.dtype)]
+                    )
+                p = np.asarray(self._predict(chunk_ids, chunk_vals))
+                out[i : i + b] = p[:b]
+        return out
+
+    def score_instances(self, instances: list[dict]) -> np.ndarray:
+        ids = np.asarray([inst["feat_ids"] for inst in instances], np.int64)
+        vals = np.asarray(
+            [inst["feat_vals"] for inst in instances], np.float32
+        )
+        return self.score(ids, vals)
+
+
+def make_handler(scorer: Scorer, model_name: str):
+    predict_path = f"/v1/models/{model_name}:predict"
+    status_path = f"/v1/models/{model_name}"
+
+    class Handler(BaseHTTPRequestHandler):
+        def _send(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 (http.server API)
+            if self.path == status_path:
+                self._send(
+                    200,
+                    {
+                        "model_version_status": [
+                            {"version": "1", "state": "AVAILABLE"}
+                        ]
+                    },
+                )
+            else:
+                self._send(404, {"error": f"unknown path {self.path!r}"})
+
+        def do_POST(self):  # noqa: N802
+            if self.path != predict_path:
+                self._send(404, {"error": f"unknown path {self.path!r}"})
+                return
+            # parse/validate -> 400 (client's fault); scoring -> 500
+            # (server's fault, e.g. a device/runtime error mid-request) so
+            # clients and monitoring can tell outages from bad requests
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(length))
+                instances = req["instances"]
+            except Exception as e:
+                self._send(400, {"error": f"{type(e).__name__}: {e}"})
+                return
+            try:
+                probs = scorer.score_instances(instances)
+            except (ValueError, KeyError, TypeError) as e:
+                self._send(400, {"error": f"{type(e).__name__}: {e}"})
+                return
+            except Exception as e:
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                return
+            self._send(200, {"predictions": [float(p) for p in probs]})
+
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+    return Handler
+
+
+def serve_forever(
+    servable_dir: str, *, port: int = 8501, host: str = "127.0.0.1",
+    model_name: str = "deepfm", batch_size: int = 256,
+    ready: threading.Event | None = None,
+) -> None:
+    from .export import load_servable
+
+    predict, cfg = load_servable(servable_dir)
+    scorer = Scorer(predict, cfg.model.field_size, batch_size)
+    httpd = ThreadingHTTPServer(
+        (host, port), make_handler(scorer, model_name)
+    )
+    if ready is not None:
+        ready.port = httpd.server_address[1]  # type: ignore[attr-defined]
+        ready.set()
+    print(
+        f"serving {model_name} on http://{httpd.server_address[0]}:"
+        f"{httpd.server_address[1]}/v1/models/{model_name}:predict",
+        file=sys.stderr,
+    )
+    httpd.serve_forever()
+
+
+def score_stdin(servable_dir: str, *, batch_size: int = 256) -> int:
+    """libsvm or JSONL lines on stdin -> one probability per line."""
+    from ..data.libsvm import parse_libsvm_line
+    from .export import load_servable
+
+    predict, cfg = load_servable(servable_dir)
+    scorer = Scorer(predict, cfg.model.field_size, batch_size)
+    count = 0
+    buf_ids: list[list[int]] = []
+    buf_vals: list[list[float]] = []
+
+    def flush():
+        nonlocal count
+        if not buf_ids:
+            return
+        probs = scorer.score(
+            np.asarray(buf_ids, np.int64), np.asarray(buf_vals, np.float32)
+        )
+        for p in probs:
+            sys.stdout.write(f"{float(p):.6f}\n")
+        sys.stdout.flush()  # pipeline consumers see results per batch
+        count += len(buf_ids)
+        buf_ids.clear()
+        buf_vals.clear()
+
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("{"):
+            obj = json.loads(line)
+            buf_ids.append(obj["feat_ids"])
+            buf_vals.append(obj["feat_vals"])
+        else:
+            _, ids, vals = parse_libsvm_line(line)
+            buf_ids.append(ids)
+            buf_vals.append(vals)
+        if len(buf_ids) >= batch_size:
+            flush()
+    flush()
+    sys.stdout.flush()
+    return count
+
+
+def main(argv: list[str] | None = None) -> int:
+    from ..core.platform import sanitize_backend
+
+    sanitize_backend()
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--servable", required=True)
+    ap.add_argument("--port", type=int, default=8501)
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="bind address (0.0.0.0 for non-loopback clients)")
+    ap.add_argument("--model-name", default="deepfm")
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument(
+        "--stdin", action="store_true",
+        help="score stdin lines (libsvm or JSONL) instead of serving HTTP",
+    )
+    args = ap.parse_args(argv)
+    if args.stdin:
+        score_stdin(args.servable, batch_size=args.batch_size)
+        return 0
+    serve_forever(
+        args.servable, port=args.port, host=args.host,
+        model_name=args.model_name, batch_size=args.batch_size,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,135 @@
+"""Online scoring endpoint (serve/server.py): the TF-Serving-role parity —
+REST predict with the TF Serving request shape, and stdin scoring."""
+
+import io
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deepfm_tpu.core.config import Config
+from deepfm_tpu.serve import export_servable, load_servable
+from deepfm_tpu.serve.server import Scorer, score_stdin, serve_forever
+from deepfm_tpu.train import create_train_state
+
+FEATURE, FIELD = 64, 5
+
+
+@pytest.fixture(scope="module")
+def servable_dir(tmp_path_factory):
+    cfg = Config.from_dict(
+        {
+            "model": {
+                "feature_size": FEATURE,
+                "field_size": FIELD,
+                "embedding_size": 4,
+                "deep_layers": (8,),
+                "dropout_keep": (1.0,),
+                "compute_dtype": "float32",
+            },
+            "optimizer": {"learning_rate": 0.01},
+        }
+    )
+    state = create_train_state(cfg)
+    d = tmp_path_factory.mktemp("servable")
+    export_servable(cfg, state, d)
+    return str(d)
+
+
+def _instances(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {
+            "feat_ids": rng.integers(0, FEATURE, FIELD).tolist(),
+            "feat_vals": rng.random(FIELD).round(4).tolist(),
+        }
+        for _ in range(n)
+    ]
+
+
+def test_scorer_matches_direct_predict(servable_dir):
+    predict, cfg = load_servable(servable_dir)
+    scorer = Scorer(predict, cfg.model.field_size, batch_size=8)
+    inst = _instances(13, seed=1)  # odd count exercises padding
+    got = scorer.score_instances(inst)
+    ids = np.asarray([i["feat_ids"] for i in inst], np.int64)
+    vals = np.asarray([i["feat_vals"] for i in inst], np.float32)
+    want = np.asarray(predict(ids, vals))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_rest_endpoint_tf_serving_shape(servable_dir):
+    ready = threading.Event()
+    t = threading.Thread(
+        target=serve_forever,
+        args=(servable_dir,),
+        kwargs=dict(port=0, model_name="deepfm", batch_size=8, ready=ready),
+        daemon=True,
+    )
+    t.start()
+    assert ready.wait(timeout=60), "server did not come up"
+    port = ready.port
+    base = f"http://127.0.0.1:{port}/v1/models/deepfm"
+
+    # status document
+    with urllib.request.urlopen(base, timeout=30) as r:
+        status = json.load(r)
+    assert status["model_version_status"][0]["state"] == "AVAILABLE"
+
+    # TF Serving predict shape
+    inst = _instances(5, seed=2)
+    req = urllib.request.Request(
+        f"{base}:predict",
+        data=json.dumps({"instances": inst}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=60) as r:
+        resp = json.load(r)
+    preds = resp["predictions"]
+    assert len(preds) == 5
+    assert all(0.0 <= p <= 1.0 for p in preds)
+
+    predict, cfg = load_servable(servable_dir)
+    ids = np.asarray([i["feat_ids"] for i in inst], np.int64)
+    vals = np.asarray([i["feat_vals"] for i in inst], np.float32)
+    np.testing.assert_allclose(
+        preds, np.asarray(predict(ids, vals)), rtol=1e-5
+    )
+
+    # malformed request -> 400 with an error document, server stays up
+    bad = urllib.request.Request(
+        f"{base}:predict", data=b'{"nope": 1}',
+        headers={"Content-Type": "application/json"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(bad, timeout=30)
+    assert ei.value.code == 400
+    with urllib.request.urlopen(base, timeout=30) as r:
+        assert r.status == 200
+
+
+def test_stdin_scoring_libsvm_and_jsonl(servable_dir, monkeypatch, capsys):
+    rng = np.random.default_rng(3)
+    lines = []
+    expect_rows = []
+    for i in range(7):
+        ids = rng.integers(0, FEATURE, FIELD).tolist()
+        vals = rng.random(FIELD).round(4).tolist()
+        expect_rows.append((ids, vals))
+        if i % 2:
+            lines.append(
+                json.dumps({"feat_ids": ids, "feat_vals": vals})
+            )
+        else:
+            pairs = " ".join(f"{c}:{v}" for c, v in zip(ids, vals))
+            lines.append(f"1 {pairs}")
+    monkeypatch.setattr("sys.stdin", io.StringIO("\n".join(lines) + "\n"))
+    n = score_stdin(servable_dir, batch_size=4)
+    assert n == 7
+    out = [float(x) for x in capsys.readouterr().out.split()]
+    predict, _ = load_servable(servable_dir)
+    ids = np.asarray([r[0] for r in expect_rows], np.int64)
+    vals = np.asarray([r[1] for r in expect_rows], np.float32)
+    np.testing.assert_allclose(out, np.asarray(predict(ids, vals)), atol=1e-5)
