@@ -20,6 +20,12 @@ artifact, with zero extra dependencies:
   label ignored) or JSON-object lines to one probability per line, for
   shell pipelines and smoke tests.
 
+* **retrieval mode** (automatic for two-tower servables): ``:encode_user``
+  and ``:encode_item`` return L2-normalized embeddings; with
+  ``--item-corpus`` (JSONL items encoded at startup) ``:retrieve`` returns
+  top-k corpus ids + scores per user query — the dual-encoder deployment
+  pattern (query encoding online, corpus offline).
+
 Requests are scored through the jitted servable ``predict`` closure
 (serve/export.py); inputs are padded to a fixed batch size so XLA compiles
 ONE executable instead of one per request size.
@@ -82,18 +88,164 @@ class Scorer:
         return self.score(ids, vals)
 
 
+class RetrievalScorer:
+    """Two-tower serving: encode either side; top-k retrieve against a
+    pre-encoded item corpus (the dual-encoder deployment pattern — query
+    encoding online, corpus encoded at startup for scoring/ANN)."""
+
+    def __init__(self, encode_user: Callable, encode_item: Callable,
+                 cfg, batch_size: int = 256):
+        self._enc = {"user": encode_user, "item": encode_item}
+        self._fields = {
+            "user": cfg.model.user_field_size,
+            "item": cfg.model.item_field_size,
+        }
+        self._batch = batch_size
+        self._lock = threading.Lock()
+        self._corpus_ids: np.ndarray | None = None
+        self._corpus_emb: np.ndarray | None = None
+
+    def encode(self, side: str, ids: np.ndarray, vals: np.ndarray) -> np.ndarray:
+        fields = self._fields[side]
+        if ids.ndim != 2 or ids.shape[1] != fields:
+            raise ValueError(
+                f"expected [N, {fields}] {side} features, got {ids.shape}"
+            )
+        n = ids.shape[0]
+        out = None
+        with self._lock:
+            for i in range(0, n, self._batch):
+                ci, cv = ids[i : i + self._batch], vals[i : i + self._batch]
+                b = ci.shape[0]
+                pad = self._batch - b
+                if pad:
+                    ci = np.concatenate([ci, np.zeros((pad, fields), ids.dtype)])
+                    cv = np.concatenate([cv, np.zeros((pad, fields), vals.dtype)])
+                e = np.asarray(self._enc[side](ci, cv))[:b]
+                if out is None:
+                    out = np.empty((n, e.shape[1]), np.float32)
+                out[i : i + b] = e
+        return out if out is not None else np.zeros((0, 0), np.float32)
+
+    def encode_instances(self, side: str, instances: list[dict]) -> np.ndarray:
+        ids = np.asarray([i[f"{side}_ids"] for i in instances], np.int64)
+        vals = np.asarray([i[f"{side}_vals"] for i in instances], np.float32)
+        return self.encode(side, ids, vals)
+
+    def load_corpus(self, path: str) -> int:
+        """JSONL corpus: one item per line,
+        ``{"id": <int>, "item_ids": [...], "item_vals": [...]}``;
+        encoded once at load."""
+        ids_out, rows = [], []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                obj = json.loads(line)
+                ids_out.append(int(obj["id"]))
+                rows.append(obj)
+        if not rows:
+            raise ValueError(f"empty item corpus {path!r}")
+        self._corpus_emb = self.encode_instances("item", rows)
+        self._corpus_ids = np.asarray(ids_out, np.int64)
+        return len(rows)
+
+    def retrieve(self, user_instances: list[dict], k: int):
+        if self._corpus_emb is None:
+            raise ValueError(
+                "no item corpus loaded (start the server with --item-corpus)"
+            )
+        k = int(k)
+        if k <= 0:
+            raise ValueError(f"k must be positive, got {k}")
+        u = self.encode_instances("user", user_instances)   # [B, D]
+        scores = u @ self._corpus_emb.T                     # [B, N]
+        k = min(k, scores.shape[1])
+        top = np.argpartition(-scores, kth=k - 1, axis=1)[:, :k]
+        row = np.arange(scores.shape[0])[:, None]
+        order = np.argsort(-scores[row, top], axis=1)
+        top = top[row, order]
+        return self._corpus_ids[top], scores[row, top]
+
+
+def make_retrieval_handler(scorer: RetrievalScorer, model_name: str):
+    base = f"/v1/models/{model_name}"
+
+    class Handler(BaseHTTPRequestHandler):
+        _send = _send_json
+
+        def do_GET(self):  # noqa: N802
+            if self.path == base:
+                self._send(
+                    200,
+                    {
+                        "model_version_status": [
+                            {"version": "1", "state": "AVAILABLE"}
+                        ],
+                        "corpus_items": (
+                            0 if scorer._corpus_ids is None
+                            else int(scorer._corpus_ids.shape[0])
+                        ),
+                    },
+                )
+            else:
+                self._send(404, {"error": f"unknown path {self.path!r}"})
+
+        def do_POST(self):  # noqa: N802
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(length))
+                instances = req["instances"]
+            except Exception as e:
+                self._send(400, {"error": f"{type(e).__name__}: {e}"})
+                return
+            try:
+                if self.path == f"{base}:encode_user":
+                    emb = scorer.encode_instances("user", instances)
+                    self._send(200, {"embeddings": emb.tolist()})
+                elif self.path == f"{base}:encode_item":
+                    emb = scorer.encode_instances("item", instances)
+                    self._send(200, {"embeddings": emb.tolist()})
+                elif self.path == f"{base}:retrieve":
+                    ids, scores = scorer.retrieve(
+                        instances, req.get("k", 10)
+                    )
+                    self._send(
+                        200,
+                        {
+                            "neighbors": ids.tolist(),
+                            "scores": scores.tolist(),
+                        },
+                    )
+                else:
+                    self._send(404, {"error": f"unknown path {self.path!r}"})
+            except (ValueError, KeyError, TypeError) as e:
+                self._send(400, {"error": f"{type(e).__name__}: {e}"})
+            except Exception as e:
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+        def log_message(self, fmt, *args):
+            pass
+
+    return Handler
+
+
+def _send_json(self, code: int, payload: dict) -> None:
+    body = json.dumps(payload).encode()
+    self.send_response(code)
+    self.send_header("Content-Type", "application/json")
+    self.send_header("Content-Length", str(len(body)))
+    self.end_headers()
+    self.wfile.write(body)
+
+
 def make_handler(scorer: Scorer, model_name: str):
     predict_path = f"/v1/models/{model_name}:predict"
     status_path = f"/v1/models/{model_name}"
 
     class Handler(BaseHTTPRequestHandler):
-        def _send(self, code: int, payload: dict) -> None:
-            body = json.dumps(payload).encode()
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+        _send = _send_json
 
         def do_GET(self):  # noqa: N802 (http.server API)
             if self.path == status_path:
@@ -141,21 +293,42 @@ def make_handler(scorer: Scorer, model_name: str):
 def serve_forever(
     servable_dir: str, *, port: int = 8501, host: str = "127.0.0.1",
     model_name: str = "deepfm", batch_size: int = 256,
+    item_corpus: str | None = None,
     ready: threading.Event | None = None,
 ) -> None:
-    from .export import load_servable
+    """Serve whichever servable lives at ``servable_dir``: CTR models get
+    ``:predict``; two-tower retrieval gets ``:encode_user``/``:encode_item``
+    and — with ``item_corpus`` — ``:retrieve``."""
+    import os
 
-    predict, cfg = load_servable(servable_dir)
-    scorer = Scorer(predict, cfg.model.field_size, batch_size)
-    httpd = ThreadingHTTPServer(
-        (host, port), make_handler(scorer, model_name)
-    )
+    from .export import _load_config, load_retrieval_servable, load_servable
+
+    cfg = _load_config(os.path.abspath(servable_dir))
+    if cfg.model.model_name == "two_tower":
+        encode_user, encode_item, cfg = load_retrieval_servable(servable_dir)
+        rscorer = RetrievalScorer(encode_user, encode_item, cfg, batch_size)
+        if item_corpus:
+            n = rscorer.load_corpus(item_corpus)
+            print(f"encoded item corpus: {n} items", file=sys.stderr)
+        handler = make_retrieval_handler(rscorer, model_name)
+        endpoint = "encode_user|encode_item|retrieve"
+    else:
+        if item_corpus:
+            raise ValueError(
+                f"--item-corpus only applies to two-tower servables; "
+                f"{servable_dir!r} holds {cfg.model.model_name!r}"
+            )
+        predict, cfg = load_servable(servable_dir)
+        scorer = Scorer(predict, cfg.model.field_size, batch_size)
+        handler = make_handler(scorer, model_name)
+        endpoint = "predict"
+    httpd = ThreadingHTTPServer((host, port), handler)
     if ready is not None:
         ready.port = httpd.server_address[1]  # type: ignore[attr-defined]
         ready.set()
     print(
         f"serving {model_name} on http://{httpd.server_address[0]}:"
-        f"{httpd.server_address[1]}/v1/models/{model_name}:predict",
+        f"{httpd.server_address[1]}/v1/models/{model_name}:{endpoint}",
         file=sys.stderr,
     )
     httpd.serve_forever()
@@ -214,6 +387,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--port", type=int, default=8501)
     ap.add_argument("--host", default="127.0.0.1",
                     help="bind address (0.0.0.0 for non-loopback clients)")
+    ap.add_argument(
+        "--item-corpus", default=None,
+        help="two-tower only: JSONL item corpus "
+             '({"id": N, "item_ids": [...], "item_vals": [...]} per line) '
+             "encoded at startup to enable the :retrieve endpoint",
+    )
     ap.add_argument("--model-name", default="deepfm")
     ap.add_argument("--batch-size", type=int, default=256)
     ap.add_argument(
@@ -227,6 +406,7 @@ def main(argv: list[str] | None = None) -> int:
     serve_forever(
         args.servable, port=args.port, host=args.host,
         model_name=args.model_name, batch_size=args.batch_size,
+        item_corpus=args.item_corpus,
     )
     return 0
 

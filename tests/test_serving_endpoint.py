@@ -110,6 +110,122 @@ def test_rest_endpoint_tf_serving_shape(servable_dir):
         assert r.status == 200
 
 
+@pytest.fixture(scope="module")
+def retrieval_servable_dir(tmp_path_factory):
+    import jax
+    import jax.numpy as jnp
+
+    from deepfm_tpu.models.two_tower import init_two_tower
+    from deepfm_tpu.train.step import TrainState
+
+    cfg = Config.from_dict(
+        {
+            "model": {
+                "model_name": "two_tower",
+                "feature_size": FEATURE,
+                "field_size": FIELD,
+                "embedding_size": 4,
+                "deep_layers": (8,),
+                "dropout_keep": (1.0,),
+                "compute_dtype": "float32",
+                "user_vocab_size": 50,
+                "item_vocab_size": 40,
+                "user_field_size": 2,
+                "item_field_size": 3,
+                "tower_layers": (8,),
+                "tower_dim": 4,
+            },
+            "optimizer": {"learning_rate": 0.01},
+        }
+    )
+    params, mstate = init_two_tower(jax.random.PRNGKey(0), cfg.model)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32), params=params, model_state=mstate,
+        opt_state=(), rng=jax.random.PRNGKey(0),
+    )
+    d = tmp_path_factory.mktemp("retrieval_servable")
+    export_servable(cfg, state, d)
+    return str(d)
+
+
+def test_retrieval_endpoints(retrieval_servable_dir, tmp_path):
+    from deepfm_tpu.serve import load_retrieval_servable
+
+    rng = np.random.default_rng(5)
+    corpus = [
+        {
+            "id": 1000 + i,
+            "item_ids": rng.integers(0, 40, 3).tolist(),
+            "item_vals": np.ones(3).tolist(),
+        }
+        for i in range(25)
+    ]
+    corpus_path = tmp_path / "items.jsonl"
+    corpus_path.write_text(
+        "\n".join(json.dumps(c) for c in corpus) + "\n"
+    )
+
+    ready = threading.Event()
+    t = threading.Thread(
+        target=serve_forever,
+        args=(retrieval_servable_dir,),
+        kwargs=dict(
+            port=0, model_name="tower", batch_size=8,
+            item_corpus=str(corpus_path), ready=ready,
+        ),
+        daemon=True,
+    )
+    t.start()
+    assert ready.wait(timeout=120), "retrieval server did not come up"
+    base = f"http://127.0.0.1:{ready.port}/v1/models/tower"
+
+    with urllib.request.urlopen(base, timeout=30) as r:
+        status = json.load(r)
+    assert status["corpus_items"] == 25
+
+    users = [
+        {
+            "user_ids": rng.integers(0, 50, 2).tolist(),
+            "user_vals": np.ones(2).tolist(),
+        }
+        for _ in range(3)
+    ]
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            f"{base}:{path}", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return json.load(r)
+
+    emb = np.asarray(post("encode_user", {"instances": users})["embeddings"])
+    assert emb.shape == (3, 4)
+    np.testing.assert_allclose(np.linalg.norm(emb, axis=-1), 1.0, rtol=1e-5)
+
+    resp = post("retrieve", {"instances": users, "k": 5})
+    neighbors = np.asarray(resp["neighbors"])
+    scores = np.asarray(resp["scores"])
+    assert neighbors.shape == scores.shape == (3, 5)
+    # scores sorted descending; neighbors come from the corpus id space
+    assert (np.diff(scores, axis=1) <= 1e-6).all()
+    assert set(neighbors.ravel().tolist()) <= {c["id"] for c in corpus}
+
+    # oracle: exact top-5 against directly-encoded corpus
+    encode_user, encode_item, _ = load_retrieval_servable(
+        retrieval_servable_dir
+    )
+    iids = np.asarray([c["item_ids"] for c in corpus], np.int64)
+    ivals = np.asarray([c["item_vals"] for c in corpus], np.float32)
+    uids = np.asarray([u["user_ids"] for u in users], np.int64)
+    uvals = np.asarray([u["user_vals"] for u in users], np.float32)
+    all_scores = np.asarray(encode_user(uids, uvals)) @ np.asarray(
+        encode_item(iids, ivals)
+    ).T
+    want = np.argsort(-all_scores, axis=1)[:, :5] + 1000
+    np.testing.assert_array_equal(neighbors, want)
+
+
 def test_stdin_scoring_libsvm_and_jsonl(servable_dir, monkeypatch, capsys):
     rng = np.random.default_rng(3)
     lines = []
