@@ -46,6 +46,34 @@ from typing import Callable
 import numpy as np
 
 
+def _apply_fixed_batch(
+    fn: Callable, ids: np.ndarray, vals: np.ndarray,
+    *, fields: int, batch_size: int, lock: threading.Lock,
+) -> np.ndarray:
+    """Run ``fn(ids, vals)`` over [N, F] inputs in fixed-size chunks, zero-
+    padding the tail so XLA compiles exactly one executable.  Output may be
+    [B] (probabilities) or [B, D] (embeddings)."""
+    if ids.ndim != 2 or ids.shape[1] != fields:
+        raise ValueError(f"expected [N, {fields}] features, got {ids.shape}")
+    n = ids.shape[0]
+    out = None
+    with lock:
+        for i in range(0, n, batch_size):
+            ci, cv = ids[i : i + batch_size], vals[i : i + batch_size]
+            b = ci.shape[0]
+            pad = batch_size - b
+            if pad:
+                ci = np.concatenate([ci, np.zeros((pad, fields), ids.dtype)])
+                cv = np.concatenate([cv, np.zeros((pad, fields), vals.dtype)])
+            res = np.asarray(fn(ci, cv))[:b]
+            if out is None:
+                out = np.empty((n, *res.shape[1:]), np.float32)
+            out[i : i + b] = res
+    if out is None:
+        return np.zeros((0,), np.float32)
+    return out
+
+
 class Scorer:
     """Fixed-batch wrapper over the servable predict closure."""
 
@@ -57,28 +85,10 @@ class Scorer:
 
     def score(self, ids: np.ndarray, vals: np.ndarray) -> np.ndarray:
         """ids/vals [N, F] -> prob [N], padded through the fixed batch."""
-        if ids.ndim != 2 or ids.shape[1] != self._fields:
-            raise ValueError(
-                f"expected [N, {self._fields}] features, got {ids.shape}"
-            )
-        n = ids.shape[0]
-        out = np.empty(n, np.float32)
-        with self._lock:
-            for i in range(0, n, self._batch):
-                chunk_ids = ids[i : i + self._batch]
-                chunk_vals = vals[i : i + self._batch]
-                b = chunk_ids.shape[0]
-                pad = self._batch - b
-                if pad:
-                    chunk_ids = np.concatenate(
-                        [chunk_ids, np.zeros((pad, self._fields), ids.dtype)]
-                    )
-                    chunk_vals = np.concatenate(
-                        [chunk_vals, np.zeros((pad, self._fields), vals.dtype)]
-                    )
-                p = np.asarray(self._predict(chunk_ids, chunk_vals))
-                out[i : i + b] = p[:b]
-        return out
+        return _apply_fixed_batch(
+            self._predict, ids, vals,
+            fields=self._fields, batch_size=self._batch, lock=self._lock,
+        )
 
     def score_instances(self, instances: list[dict]) -> np.ndarray:
         ids = np.asarray([inst["feat_ids"] for inst in instances], np.int64)
@@ -106,26 +116,14 @@ class RetrievalScorer:
         self._corpus_emb: np.ndarray | None = None
 
     def encode(self, side: str, ids: np.ndarray, vals: np.ndarray) -> np.ndarray:
-        fields = self._fields[side]
-        if ids.ndim != 2 or ids.shape[1] != fields:
-            raise ValueError(
-                f"expected [N, {fields}] {side} features, got {ids.shape}"
+        try:
+            return _apply_fixed_batch(
+                self._enc[side], ids, vals,
+                fields=self._fields[side], batch_size=self._batch,
+                lock=self._lock,
             )
-        n = ids.shape[0]
-        out = None
-        with self._lock:
-            for i in range(0, n, self._batch):
-                ci, cv = ids[i : i + self._batch], vals[i : i + self._batch]
-                b = ci.shape[0]
-                pad = self._batch - b
-                if pad:
-                    ci = np.concatenate([ci, np.zeros((pad, fields), ids.dtype)])
-                    cv = np.concatenate([cv, np.zeros((pad, fields), vals.dtype)])
-                e = np.asarray(self._enc[side](ci, cv))[:b]
-                if out is None:
-                    out = np.empty((n, e.shape[1]), np.float32)
-                out[i : i + b] = e
-        return out if out is not None else np.zeros((0, 0), np.float32)
+        except ValueError as e:
+            raise ValueError(f"{side}: {e}") from None
 
     def encode_instances(self, side: str, instances: list[dict]) -> np.ndarray:
         ids = np.asarray([i[f"{side}_ids"] for i in instances], np.int64)
@@ -193,6 +191,13 @@ def make_retrieval_handler(scorer: RetrievalScorer, model_name: str):
                 self._send(404, {"error": f"unknown path {self.path!r}"})
 
         def do_POST(self):  # noqa: N802
+            known = {
+                f"{base}:encode_user", f"{base}:encode_item",
+                f"{base}:retrieve",
+            }
+            if self.path not in known:
+                self._send(404, {"error": f"unknown path {self.path!r}"})
+                return
             try:
                 length = int(self.headers.get("Content-Length", "0"))
                 req = json.loads(self.rfile.read(length))
@@ -218,8 +223,6 @@ def make_retrieval_handler(scorer: RetrievalScorer, model_name: str):
                             "scores": scores.tolist(),
                         },
                     )
-                else:
-                    self._send(404, {"error": f"unknown path {self.path!r}"})
             except (ValueError, KeyError, TypeError) as e:
                 self._send(400, {"error": f"{type(e).__name__}: {e}"})
             except Exception as e:

@@ -193,13 +193,31 @@ def run_eval(cfg: Config, ctx: SPMDContext, state: TrainState, log: MetricLogger
     multiple with zero-weight rows, so every record counts exactly once."""
     eval_step = make_spmd_eval_step(ctx)
     dp = ctx.mesh.shape["data"]
+    nproc, pid = jax.process_count(), jax.process_index()
+    if nproc > 1 and dp % nproc != 0:
+        raise ValueError(
+            f"multi-process eval needs the data axis ({dp}) divisible by "
+            f"the process count ({nproc}) so each process can feed its row "
+            f"slice of the global batch"
+        )
     auc_state = new_auc_state()
     loss_sum, counts = 0.0, 0
+    fed_rows = 0.0  # non-padding rows THIS process placed on the mesh
     for batch, true_count in _padded_batches(_eval_batches(cfg, ctx), dp):
         b = batch["label"].shape[0]
         batch["weight"] = np.concatenate(
             [np.ones(true_count, np.float32), np.zeros(b - true_count, np.float32)]
         )
+        if nproc > 1:
+            # every process reads the IDENTICAL global stream (collective
+            # eval steps must stay in lockstep — per-process sharding could
+            # leave uneven step counts and deadlock); each feeds only its
+            # row slice, so no record enters the global batch twice.  b is
+            # a dp multiple (padded above) and dp % nproc == 0 (checked),
+            # so the slices partition the batch exactly.
+            lb = b // nproc
+            batch = {k: v[pid * lb : (pid + 1) * lb] for k, v in batch.items()}
+        fed_rows += float(batch["weight"].sum())
         sb = shard_batch(ctx, batch)
         auc_state, m = eval_step(state, auc_state, sb)
         # float(m["loss"]) below blocks per batch, which also keeps CPU-mesh
@@ -210,6 +228,9 @@ def run_eval(cfg: Config, ctx: SPMDContext, state: TrainState, log: MetricLogger
         "auc": float(auc_value(auc_state)),
         "loss": (loss_sum / counts) if counts else float("nan"),
         "examples": counts,
+        # sums to `examples` ACROSS processes — the observable no-double-
+        # feed invariant (each record scored exactly once globally)
+        "fed_rows": int(fed_rows),
     }
     log.event("eval", **result)
     return result

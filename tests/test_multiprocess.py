@@ -138,6 +138,13 @@ def test_two_process_cli_lifecycle(tmp_path):
     for out in outs:
         assert "MP_CLI_OK" in out
         assert '"kind": "eval"' in out      # final eval ran
+        # every process reads the full 64-record channel but feeds only its
+        # slice: the reported example count is the channel size, and each
+        # process places exactly half the rows (fed_rows sums to examples
+        # across processes — the no-double-feed invariant; a regression to
+        # full-batch feeding would log fed_rows=64 here)
+        assert '"examples": 64' in out, out[-2000:]
+        assert '"fed_rows": 32' in out, out[-2000:]
     # per-host record sharding: 2 epochs x 256 records / (16/host x 2 hosts)
     # = 16 global steps; periodic ckpt every 5 + final -> steps 5,10,15,16
     ckpt_dir = tmp_path / "model"
