@@ -160,7 +160,9 @@ class RunConfig:
     """Run/driver config: task dispatch + paths (ps:70-79) + cluster identity
     (SM_HOSTS/SM_CURRENT_HOST analogs, ps:80-95)."""
 
-    task_type: str = "train"          # train | eval | infer | export (ps:77-79)
+    task_type: str = "train"          # train | eval | infer | export | serve
+                                      # (ps:77-79; serve = online scoring over
+                                      # the exported servable, serve/server.py)
     model_dir: str = "./model_dir"
     servable_model_dir: str = "./servable"
     clear_existing_model: bool = False  # hvd:66-68
@@ -174,6 +176,9 @@ class RunConfig:
     keep_checkpoints: int = 3
     seed: int = 0
     profile_dir: str = ""             # jax.profiler trace dir ("" = off)
+    serve_port: int = 8501            # task_type=serve bind port
+    serve_host: str = "127.0.0.1"     # bind address (0.0.0.0 for remote clients)
+    serve_item_corpus: str = ""       # two-tower: JSONL corpus for :retrieve
     # in-process crash retries with resume-from-checkpoint (the spot-retry
     # analog of use_spot_instances/max_wait, both notebooks cell 4)
     max_restarts: int = 0

@@ -58,8 +58,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--config", help="JSON config file (Config.to_dict schema)")
     p.add_argument(
         "--task_type",
-        choices=["train", "eval", "infer", "export"],
-        help="task dispatch (reference ps:77-79)",
+        choices=["train", "eval", "infer", "export", "serve"],
+        help="task dispatch (reference ps:77-79; serve = online scoring "
+             "over the exported servable)",
     )
     # the high-traffic flags get first-class spellings (parity with the
     # reference's most-used hyperparameters, ps nb cell 4)

@@ -194,11 +194,19 @@ def run_eval(cfg: Config, ctx: SPMDContext, state: TrainState, log: MetricLogger
     eval_step = make_spmd_eval_step(ctx)
     dp = ctx.mesh.shape["data"]
     nproc, pid = jax.process_count(), jax.process_index()
-    if nproc > 1 and dp % nproc != 0:
+    # feeding policy across processes:
+    #   dp % nproc == 0 -> each process feeds its row slice (exact partition)
+    #   dp == 1         -> the single data row spans processes via the model
+    #                      axis; every process feeds the identical full batch
+    #                      and assembly replicates it (no double-count)
+    #   otherwise       -> data rows straddle process boundaries; neither
+    #                      scheme is well-defined — fail loudly
+    slice_rows = nproc > 1 and dp % nproc == 0
+    if nproc > 1 and not slice_rows and dp != 1:
         raise ValueError(
             f"multi-process eval needs the data axis ({dp}) divisible by "
-            f"the process count ({nproc}) so each process can feed its row "
-            f"slice of the global batch"
+            f"the process count ({nproc}), or data_parallel=1 (replicated "
+            f"feed); this mesh straddles data rows across processes"
         )
     auc_state = new_auc_state()
     loss_sum, counts = 0.0, 0
@@ -208,7 +216,7 @@ def run_eval(cfg: Config, ctx: SPMDContext, state: TrainState, log: MetricLogger
         batch["weight"] = np.concatenate(
             [np.ones(true_count, np.float32), np.zeros(b - true_count, np.float32)]
         )
-        if nproc > 1:
+        if slice_rows:
             # every process reads the IDENTICAL global stream (collective
             # eval steps must stay in lockstep — per-process sharding could
             # leave uneven step counts and deadlock); each feeds only its
@@ -228,8 +236,10 @@ def run_eval(cfg: Config, ctx: SPMDContext, state: TrainState, log: MetricLogger
         "auc": float(auc_value(auc_state)),
         "loss": (loss_sum / counts) if counts else float("nan"),
         "examples": counts,
-        # sums to `examples` ACROSS processes — the observable no-double-
-        # feed invariant (each record scored exactly once globally)
+        # the observable no-double-feed invariant: sums to `examples`
+        # across processes when rows are sliced (dp % nproc == 0); equals
+        # `examples` on every process in the replicated dp==1 feed (the
+        # assembly deduplicates replicas there, not the feed)
         "fed_rows": int(fed_rows),
     }
     log.event("eval", **result)
@@ -522,10 +532,22 @@ def run_retrieval_task(cfg: Config):
 
 
 def run_task(cfg: Config):
-    """task_type dispatch (ps:501-551): train | eval | infer | export."""
+    """task_type dispatch (ps:501-551): train | eval | infer | export,
+    plus ``serve`` — online scoring over the exported servable (the
+    TF-Serving step of the reference's workflow, serve/server.py)."""
+    task = cfg.run.task_type
+    if task == "serve":
+        from ..serve.server import serve_forever
+
+        serve_forever(
+            cfg.run.servable_model_dir,
+            port=cfg.run.serve_port,
+            host=cfg.run.serve_host,
+            item_corpus=cfg.run.serve_item_corpus or None,
+        )
+        return None
     if cfg.model.model_name == "two_tower":
         return run_retrieval_task(cfg)
-    task = cfg.run.task_type
     if task == "train":
         return run_train(cfg)
     if task == "eval":
@@ -539,4 +561,6 @@ def run_task(cfg: Config):
         return run_infer(cfg)
     if task == "export":
         return run_export(cfg)
-    raise ValueError(f"unknown task_type {task!r} (train|eval|infer|export)")
+    raise ValueError(
+        f"unknown task_type {task!r} (train|eval|infer|export|serve)"
+    )

@@ -91,6 +91,38 @@ def test_print_config(capsys):
     assert out["model"]["feature_size"] == 42
 
 
+def test_serve_task_dispatch(monkeypatch):
+    """task_type=serve routes to serve/server.serve_forever with the
+    RunConfig serving knobs (the TF-Serving step of the workflow)."""
+    from deepfm_tpu.serve import server as srv
+    from deepfm_tpu.train.loop import run_task
+
+    calls = {}
+
+    def fake_serve(servable_dir, **kw):
+        calls["dir"] = servable_dir
+        calls.update(kw)
+
+    monkeypatch.setattr(srv, "serve_forever", fake_serve)
+    cfg = Config.from_dict(
+        {
+            "run": {
+                "task_type": "serve",
+                "servable_model_dir": "/x/servable",
+                "serve_port": 1234,
+                "serve_host": "0.0.0.0",
+            }
+        }
+    )
+    assert run_task(cfg) is None
+    assert calls == {
+        "dir": "/x/servable",
+        "port": 1234,
+        "host": "0.0.0.0",
+        "item_corpus": None,
+    }
+
+
 def test_full_lifecycle_train_eval_export_infer(data_dir, tmp_path, capsys):
     """End-to-end: train 2 epochs on the 4x2 mesh, checkpoint, eval, export,
     then resume more training and run infer to pred.txt."""
