@@ -29,4 +29,12 @@ DEEPFM_LV_PLATFORM=axon timeout 1800 \
     python benchmarks/large_vocab.py --rows 10000000 --steps 20 \
     --src-mesh 1,1 --dst-mesh 1,1 --persist || status=1
 
+echo "== host<->device transfer bandwidth (frames the e2e/feed numbers) =="
+JAX_PLATFORMS=axon timeout 900 \
+    python benchmarks/transfer.py --persist || status=1
+
+echo "== batch-size x variant tuning sweep (per-point process isolation) =="
+JAX_PLATFORMS=axon timeout 5400 \
+    python benchmarks/tpu_tune.py --persist || status=1
+
 exit $status

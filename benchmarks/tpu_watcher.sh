@@ -7,8 +7,10 @@
 # successful attach it fires benchmarks/tpu_session.sh — which persists
 # BENCH_TPU.json, compiled Pallas test results, collective + ingest numbers —
 # and commits those artifacts (with index.lock retries, since the builder may
-# be committing concurrently).  Exits after a successful session, or when
-# MAX_RUNTIME elapses, leaving the attempt log as evidence either way.
+# be committing concurrently).  After a session it re-arms (every persist
+# path refuses to clobber good TPU data), so later windows refresh the
+# artifacts; at MAX_RUNTIME it exits 0 if at least one session ran, else 2,
+# leaving the attempt log as evidence either way.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
@@ -20,6 +22,7 @@ mkdir -p docs
 
 start=$(date +%s)
 probe_n=0
+sessions_ok=0
 
 log_attempt() {  # $1 = outcome, $2 = latency_s
     printf '{"ts": %s, "probe": %d, "outcome": "%s", "latency_s": %s}\n' \
@@ -35,6 +38,7 @@ commit_with_retry() {
     local paths=() p branch old tree new idx
     for p in BENCH_TPU.json docs/BENCH_COLLECTIVES.json \
         docs/BENCH_INGEST.json docs/BENCH_LARGE_VOCAB.json \
+        docs/BENCH_TRANSFER.json docs/BENCH_TPU_TUNE.json \
         docs/TPU_WATCHER_LOG.jsonl docs/TPU_SESSION_OUT.log; do
         [[ -e $p ]] && paths+=("$p")
     done
@@ -66,25 +70,44 @@ commit_with_retry() {
 while :; do
     now=$(date +%s)
     if (( now - start > MAX_RUNTIME )); then
+        if (( sessions_ok > 0 )); then
+            log_attempt "watcher_done" "$sessions_ok"
+            echo "watcher: max runtime reached after $sessions_ok session(s)"
+            exit 0
+        fi
         log_attempt "watcher_timeout" 0
         echo "watcher: max runtime reached without a TPU window"
         exit 2
     fi
     probe_n=$((probe_n + 1))
     t0=$(date +%s)
-    if JAX_PLATFORMS=axon timeout "$PROBE_TIMEOUT" python -c \
-        "import jax; d = jax.devices(); print('OK', d[0].device_kind)" \
+    # readiness = attach AND a real (tiny) compile+execute round trip: the
+    # attach can succeed while the remote compile service is wedged, and a
+    # session fired into that state burns every phase's timeout for nothing
+    if JAX_PLATFORMS=axon timeout "$PROBE_TIMEOUT" python -c "
+import jax, jax.numpy as jnp
+f = jax.jit(lambda x: (x @ x).sum())
+print('OK', f(jnp.ones((128, 128))).block_until_ready())" \
         >/dev/null 2>&1; then
         dt=$(( $(date +%s) - t0 ))
         log_attempt "attach_ok" "$dt"
-        echo "watcher: TPU attach ok after probe $probe_n (${dt}s) — running session"
+        echo "watcher: TPU ready after probe $probe_n (${dt}s) — running session"
         if bash benchmarks/tpu_session.sh > docs/TPU_SESSION_OUT.log 2>&1; then
             log_attempt "session_ok" 0
         else
             log_attempt "session_partial" 0
         fi
+        sessions_ok=$((sessions_ok + 1))
         commit_with_retry
-        exit 0
+        # re-arm: a later window refreshes artifacts (every bench persist
+        # path is history-preserving / refuses to clobber good data);
+        # capped to the remaining budget so the watcher never outlives it
+        log_attempt "rearm" 0
+        rearm="${REARM_INTERVAL:-7200}"
+        remaining=$(( start + MAX_RUNTIME - $(date +%s) ))
+        (( remaining < 1 )) && remaining=1
+        sleep $(( rearm < remaining ? rearm : remaining ))
+        continue
     fi
     dt=$(( $(date +%s) - t0 ))
     log_attempt "attach_fail" "$dt"

@@ -30,14 +30,24 @@ row exactly once, in sorted order:
 On Zipf-skewed Criteo ids a batch of 1024×39 lookups hits only ~30-40% as
 many unique rows, and sorted adjacency packs ~`128/K` unique rows per
 window, so HBM traffic drops several-fold exactly where the round-1 kernel
-lost to XLA (hot windows were re-DMA'd per duplicate; the round-1 v1 kernel
-measured ~240µs vs ~104µs XLA per train step on a v5e — HISTORICAL numbers
-for the superseded kernel, not reproduced for v2; no committed artifact
-backs them until a tunnel window lets tests/test_pallas_ctr.py +
-bench.py run compiled).  Uniform ids benefit from the window packing alone.
-The dedup's sort also pays for the backward: the custom VJP segment-sums
-row gradients by the same inverse map and scatter-adds each unique row
-once — no duplicate-index scatter serialization.
+lost to XLA (hot windows were re-DMA'd per duplicate).  Uniform ids benefit
+from the window packing alone.  The dedup's sort also pays for the
+backward: the custom VJP segment-sums row gradients by the same inverse
+map and scatter-adds each unique row once — no duplicate-index scatter
+serialization.
+
+**Measured on a real v5e chip (round 3, docs/BENCH_TPU_TUNE.json)**: v2
+compiles and is bit-correct on hardware (tests/test_pallas_ctr.py compiled)
+and the whole-step rate at the flagship shape (V=117,581, F=39, K=32) is
+within a few percent of the XLA-gather path across batch sizes — e.g.
+~170 µs vs ~135 µs at batch 1024, and at batch 4096 the fused kernel edges
+XLA out (25.0M vs 23.4M ex/s).  At this vocab the 15 MB table is
+VMEM-resident, so XLA's plain gather is already near-optimal and the step
+is bounded by the fixed dense-Adam state update; the dedup design's real
+payoff is the regime where the table does NOT fit fast memory (the
+100M-row north star served by the lazy path, docs/BENCH_LARGE_VOCAB.json).
+The default stays "off": XLA wins or ties at reference shapes, with
+hardware evidence either way.
 
 Only the gathered working set sits in VMEM, so the kernel scales to
 vocabularies far beyond VMEM (the 100M-row north star) — the table stays in
@@ -47,8 +57,8 @@ HBM-DMA latency instead of network latency.
 
 Use ``fused_ctr_interaction`` (the custom-vjp wrapper).  On CPU the kernel
 runs in Pallas interpret mode — the same code path CI exercises
-deterministically (tests/test_pallas_ctr.py).  Default remains
-``fused_kernel="off"`` until the v2 numbers are recorded on real hardware
+deterministically (tests/test_pallas_ctr.py).  The default stays
+``fused_kernel="off"`` per the recorded round-3 hardware evidence above
 (bench.py measures both paths and reports the faster).
 """
 
@@ -224,12 +234,43 @@ def _gather_unique(fm_v, win, sel, first, dist, dma_rows, *, interpret: bool):
     )(win, first, dma_rows, sel[:, None], dist[:, None], table)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+# The dedup plan rides scalar-prefetch (SMEM, 1 MB): three int32 arrays of
+# the flat id-stream length must fit, capping one kernel invocation at
+# ~87k ids (measured: 160k ids over-subscribes SMEM 1.83M/1.00M).  Larger
+# batches are mapped through the kernel in row chunks — FM terms and emb
+# rows are independent per batch row, so chunking the batch axis is exact.
+_MAX_FLAT_IDS = 65_536
+
+
 def fused_ctr_interaction(fm_w, fm_v, ids, vals, interpret=False):
     """Fused gather + FM: (fm_w [V], fm_v [V,K], ids [B,F], vals [B,F]) ->
     (emb [B,F,K], y_w [B], y_v [B]).  emb is already vals-scaled (ps:212-214);
     y_w/y_v are the first/second-order FM terms (ps:207-217).  Out-of-range
-    ids clip to [0, V-1] like ``jnp.take(mode='clip')``."""
+    ids clip to [0, V-1] like ``jnp.take(mode='clip')``.  Batches whose flat
+    id stream exceeds the SMEM plan budget are processed in row chunks via
+    ``lax.map`` (dedup is then chunk-local; table cotangents accumulate
+    across chunks in the scan)."""
+    ids = ids.reshape(-1, ids.shape[-1])
+    vals = vals.reshape(ids.shape)
+    b, f = ids.shape
+    rows_per_chunk = max(_MAX_FLAT_IDS // f, 1)
+    if b <= rows_per_chunk:
+        return _fused_chunk(fm_w, fm_v, ids, vals, interpret)
+    pad = (-b) % rows_per_chunk
+    if pad:
+        ids = jnp.concatenate([ids, jnp.zeros((pad, f), ids.dtype)])
+        vals = jnp.concatenate([vals, jnp.zeros((pad, f), vals.dtype)])
+    emb, y_w, y_v = jax.lax.map(
+        lambda iv: _fused_chunk(fm_w, fm_v, iv[0], iv[1], interpret),
+        (ids.reshape(-1, rows_per_chunk, f), vals.reshape(-1, rows_per_chunk, f)),
+    )
+    k = emb.shape[-1]
+    return emb.reshape(-1, f, k)[:b], y_w.reshape(-1)[:b], y_v.reshape(-1)[:b]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _fused_chunk(fm_w, fm_v, ids, vals, interpret=False):
+    """One SMEM-sized chunk of the fused gather+FM (see the public wrapper)."""
     out, _ = _forward(fm_w, fm_v, ids, vals, interpret)
     return out
 
@@ -294,7 +335,7 @@ def _fused_bwd(interpret, res, cotangents):
     return d_fm_w, d_fm_v, None, d_vals.astype(vals.dtype)
 
 
-fused_ctr_interaction.defvjp(_fused_fwd, _fused_bwd)
+_fused_chunk.defvjp(_fused_fwd, _fused_bwd)
 
 
 def fused_kernel_available() -> bool:
