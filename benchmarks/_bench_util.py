@@ -1,0 +1,129 @@
+"""Shared helpers for the benchmark scripts (tpu_tune / model_zoo /
+convergence_device): synthetic Criteo batch staging, the warmup+timed step
+loop, the per-point subprocess driver, and the single {latest, runs}
+persist policy — one place to fix, three consumers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+V_FLAGSHIP = 117_581
+
+
+def make_ctr_batches(batch_size: int, nb: int = 4, *, v: int = V_FLAGSHIP,
+                     seed: int = 0):
+    """Criteo-shaped synthetic batches (13 numeric + 26 Zipf-skewed
+    categorical), device-staged so step timing excludes the host feed."""
+    import jax
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(nb):
+        numeric = rng.integers(1, 14, size=(batch_size, 13))
+        cat = 14 + (rng.zipf(1.3, size=(batch_size, 26)) % (v - 14))
+        out.append({
+            "feat_ids": jax.device_put(np.concatenate(
+                [numeric, cat], axis=1).astype(np.int64)),
+            "feat_vals": jax.device_put(np.concatenate(
+                [rng.random((batch_size, 13), dtype=np.float32),
+                 np.ones((batch_size, 26), np.float32)], axis=1)),
+            "label": jax.device_put(
+                (rng.random(batch_size) < 0.25).astype(np.float32)),
+        })
+    return out
+
+
+def time_step_loop(step_fn, state, batches, steps: int, batch_size: int):
+    """3 warmup steps (compile + dispatch), then `steps` timed steps; blocks
+    only at the end so async dispatch pipelines."""
+    import jax
+
+    nb = len(batches)
+    for i in range(3):
+        state, metrics = step_fn(state, batches[i % nb])
+    jax.block_until_ready(metrics)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, metrics = step_fn(state, batches[i % nb])
+    jax.block_until_ready(metrics)
+    dt = time.perf_counter() - t0
+    return {
+        "examples_per_sec": round(steps * batch_size / dt, 1),
+        "step_us": round(dt / steps * 1e6, 1),
+        "final_loss": round(float(metrics["loss"]), 4),
+    }
+
+
+def run_point_subprocess(cmd: list[str], timeout: int, tag: dict) -> dict:
+    """Run one measurement point isolated in a subprocess; a wedged remote
+    call costs this point, not the sweep.  `tag` labels the error row."""
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout)
+        if proc.returncode == 0 and proc.stdout.strip():
+            return json.loads(proc.stdout.strip().splitlines()[-1])
+        return dict(tag, error=(proc.stderr or "no output")[-200:])
+    except subprocess.TimeoutExpired:
+        return dict(tag, error=f"timeout after {timeout}s")
+    except Exception as e:
+        return dict(tag, error=f"{type(e).__name__}: {e}"[:200])
+
+
+def capture_platform(row: dict, current: tuple[str | None, str | None]):
+    """Fold a point row's platform/device_kind into the sweep-level pair
+    (first success wins) and strip them from the row."""
+    platform, device_kind = current
+    if platform is None and "platform" in row:
+        platform = row["platform"]
+        device_kind = row.get("device_kind")
+        print(f"platform={platform} device={device_kind}",
+              file=sys.stderr, flush=True)
+    row.pop("platform", None)
+    row.pop("device_kind", None)
+    return platform, device_kind
+
+
+def backend_platform() -> tuple[str, str]:
+    """(platform, device_kind) with tunneled TPU plugins normalized."""
+    from deepfm_tpu.core.platform import is_tpu_backend
+
+    import jax
+
+    platform = "tpu" if is_tpu_backend() else jax.devices()[0].platform
+    return platform, jax.devices()[0].device_kind
+
+
+def persist_latest_runs(path: str, out: dict, *, ok: int,
+                        platform: str | None) -> None:
+    """The single persist policy: {latest, runs} history; keep the previous
+    latest when this run has zero successful points or would demote
+    real-TPU data with a fallback-platform run; migrate legacy flat files.
+    """
+    latest, runs = out, []
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                prev = json.load(f)
+            runs = prev.get("runs", [])
+            if "latest" in prev:
+                prev_latest = prev["latest"]
+            else:  # legacy flat shape: fold it into history
+                prev_latest = {k: v for k, v in prev.items() if k != "runs"}
+                runs = runs + [prev_latest]
+            if ok == 0 or (prev_latest.get("platform") == "tpu"
+                           and platform != "tpu"):
+                latest = prev_latest
+                print(f"keeping previous latest ({path}): ok={ok} "
+                      f"platform={platform}", file=sys.stderr)
+        except Exception:
+            runs = []
+    with open(path, "w") as f:
+        json.dump({"latest": latest, "runs": runs + [out]}, f, indent=1)
+    print(f"persisted {path}", file=sys.stderr)

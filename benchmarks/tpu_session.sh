@@ -37,4 +37,13 @@ echo "== batch-size x variant tuning sweep (per-point process isolation) =="
 JAX_PLATFORMS=axon timeout 5400 \
     python benchmarks/tpu_tune.py --persist || status=1
 
+echo "== model-family step rates (xDeepFM / DCN-v2 / two-tower) =="
+JAX_PLATFORMS=axon timeout 5400 \
+    python benchmarks/model_zoo.py --persist || status=1
+
+echo "== Criteo-Kaggle-scale convergence on device (45M records/epoch) =="
+JAX_PLATFORMS=axon timeout 2400 \
+    python benchmarks/convergence_device.py --records-per-epoch 45000000 \
+    --epochs 4 --batch 16384 --persist || status=1
+
 exit $status

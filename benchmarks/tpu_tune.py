@@ -24,33 +24,13 @@ import os
 import sys
 import time
 
-import numpy as np
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import _bench_util as bu
 
 V, F, K = 117_581, 39, 32
 DEEP = (128, 64, 32)
-
-
-def make_batches(batch_size: int, nb: int = 4):
-    import jax
-
-    rng = np.random.default_rng(0)
-    batches = []
-    for _ in range(nb):
-        numeric = rng.integers(1, 14, size=(batch_size, 13))
-        cat = 14 + (rng.zipf(1.3, size=(batch_size, 26)) % (V - 14))
-        ids = np.concatenate([numeric, cat], axis=1).astype(np.int64)
-        vals = np.concatenate(
-            [rng.random((batch_size, 13), dtype=np.float32),
-             np.ones((batch_size, 26), dtype=np.float32)], axis=1)
-        labels = (rng.random(batch_size) < 0.25).astype(np.float32)
-        batches.append({
-            "feat_ids": jax.device_put(ids),
-            "feat_vals": jax.device_put(vals),
-            "label": jax.device_put(labels),
-        })
-    return batches
 
 
 def measure(batch_size: int, fused: str, lazy: bool, steps: int) -> dict:
@@ -69,26 +49,17 @@ def measure(batch_size: int, fused: str, lazy: bool, steps: int) -> dict:
                       "lazy_embedding_updates": lazy},
         "data": {"batch_size": batch_size},
     })
-    batches = make_batches(batch_size)
-    nb = len(batches)
     state = create_train_state(cfg)
     step_fn = jax.jit(make_train_step(cfg), donate_argnums=(0,))
-    for i in range(3):
-        state, metrics = step_fn(state, batches[i % nb])
-    jax.block_until_ready(metrics)
-    t0 = time.perf_counter()
-    for i in range(steps):
-        state, metrics = step_fn(state, batches[i % nb])
-    jax.block_until_ready(metrics)
-    dt = time.perf_counter() - t0
-    return {
-        "batch_size": batch_size,
-        "variant": ("pallas" if fused == "on" else
-                    "lazy_adam" if lazy else "xla"),
-        "examples_per_sec": round(steps * batch_size / dt, 1),
-        "step_us": round(dt / steps * 1e6, 1),
-        "final_loss": round(float(metrics["loss"]), 4),
-    }
+    r = bu.time_step_loop(
+        step_fn, state, bu.make_ctr_batches(batch_size), steps, batch_size
+    )
+    r.update(
+        batch_size=batch_size,
+        variant=("pallas" if fused == "on" else
+                 "lazy_adam" if lazy else "xla"),
+    )
+    return r
 
 
 def run_point(args) -> None:
@@ -96,15 +67,12 @@ def run_point(args) -> None:
 
     Used by the sweep driver to isolate each measurement in its own process
     (a wedged remote call then costs one point, not the sweep)."""
-    from deepfm_tpu.core.platform import is_tpu_backend, sanitize_backend
+    from deepfm_tpu.core.platform import sanitize_backend
 
     sanitize_backend()
-    import jax
-
     bs, fused, lazy = args.point.split(",")
     r = measure(int(bs), fused, lazy == "1", args.steps)
-    r["platform"] = "tpu" if is_tpu_backend() else jax.devices()[0].platform
-    r["device_kind"] = jax.devices()[0].device_kind
+    r["platform"], r["device_kind"] = bu.backend_platform()
     print(json.dumps(r))
 
 
@@ -124,52 +92,30 @@ def main() -> None:
     # the driver itself never initializes jax: holding a client on the
     # tunneled single-chip attach for the whole sweep contends with every
     # per-point subprocess; platform/device metadata comes from the points
-    import subprocess
-
     platform = device_kind = None
     rows = []
 
-    def run_one(bs: int, fused: str, lazy: bool) -> dict:
-        variant = ("pallas" if fused == "on" else
-                   "lazy_adam" if lazy else "xla")
-        cmd = [sys.executable, os.path.abspath(__file__),
-               "--point", f"{bs},{fused},{1 if lazy else 0}",
-               "--steps", str(args.steps)]
-        try:
-            proc = subprocess.run(
-                cmd, capture_output=True, text=True,
-                timeout=args.point_timeout,
-            )
-            if proc.returncode == 0 and proc.stdout.strip():
-                return json.loads(proc.stdout.strip().splitlines()[-1])
-            return {"batch_size": bs, "variant": variant,
-                    "error": (proc.stderr or "no output")[-200:]}
-        except subprocess.TimeoutExpired:
-            return {"batch_size": bs, "variant": variant,
-                    "error": f"timeout after {args.point_timeout}s"}
-        except Exception as e:
-            return {"batch_size": bs, "variant": variant,
-                    "error": f"{type(e).__name__}: {e}"[:200]}
-
     for bs in [int(b) for b in args.batches.split(",")]:
         for fused, lazy in (("off", False), ("off", True), ("on", False)):
+            variant = ("pallas" if fused == "on" else
+                       "lazy_adam" if lazy else "xla")
             if fused == "on" and platform != "tpu":
                 # pallas-compiled points only once a point has confirmed a
                 # TPU attach (interpret mode at flagship shapes is unusable);
                 # record the skip so the artifact can't read as "measured"
                 r = {"batch_size": bs, "variant": "pallas",
                      "error": f"skipped: platform unconfirmed/{platform}"}
-                rows.append(r)
-                print(json.dumps(r), file=sys.stderr, flush=True)
-                continue
-            r = run_one(bs, fused, lazy)
-            if platform is None and "platform" in r:
-                platform = r["platform"]
-                device_kind = r.get("device_kind")
-                print(f"platform={platform} device={device_kind}",
-                      file=sys.stderr, flush=True)
-            r.pop("platform", None)
-            r.pop("device_kind", None)
+            else:
+                r = bu.run_point_subprocess(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--point", f"{bs},{fused},{1 if lazy else 0}",
+                     "--steps", str(args.steps)],
+                    args.point_timeout,
+                    {"batch_size": bs, "variant": variant},
+                )
+                platform, device_kind = bu.capture_platform(
+                    r, (platform, device_kind)
+                )
             rows.append(r)
             print(json.dumps(r), file=sys.stderr, flush=True)
 
@@ -179,37 +125,12 @@ def main() -> None:
            "rows": rows}
     print(json.dumps(out))
     if args.persist:
-        # {latest, runs} history, same shape as every other bench artifact;
-        # never demote real-TPU latest on a degraded/fallback window
-        ok = sum(1 for r in rows if "error" not in r)
-        path = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "docs", "BENCH_TPU_TUNE.json")
-        latest, runs = out, []
-        if os.path.exists(path):
-            try:
-                with open(path) as f:
-                    prev = json.load(f)
-                runs = prev.get("runs", [])
-                if "latest" in prev:
-                    prev_latest = prev["latest"]
-                else:  # migrate the pre-history flat shape
-                    prev_latest = {k: v for k, v in prev.items()
-                                   if k != "runs"}
-                    runs = runs + [prev_latest]
-                keep_prev = (
-                    ok == 0
-                    or (prev_latest.get("platform") == "tpu"
-                        and platform != "tpu")
-                )
-                if keep_prev:
-                    latest = prev_latest
-                    print(f"keeping previous latest ({path}): "
-                          f"ok={ok} platform={platform}", file=sys.stderr)
-            except Exception:
-                runs = []
-        with open(path, "w") as f:
-            json.dump({"latest": latest, "runs": runs + [out]}, f, indent=1)
-        print(f"persisted {path}", file=sys.stderr)
+        bu.persist_latest_runs(
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "docs", "BENCH_TPU_TUNE.json"),
+            out, ok=sum(1 for r in rows if "error" not in r),
+            platform=platform,
+        )
 
 
 if __name__ == "__main__":

@@ -35,10 +35,17 @@ commit_with_retry() {
     # the shared index), then publish with a compare-and-swap update-ref —
     # if the builder moved HEAD meanwhile, retry on the new tip instead of
     # silently reverting it.
+    #
+    # HAZARD for anyone committing after this fires: the shared index is now
+    # STALE relative to HEAD (it never saw this commit), and a plain
+    # `git commit` from it will silently revert these artifacts.  Run
+    # `git reset -q` (refresh index from HEAD, keep working tree) before
+    # staging your next commit.
     local paths=() p branch old tree new idx
     for p in BENCH_TPU.json docs/BENCH_COLLECTIVES.json \
         docs/BENCH_INGEST.json docs/BENCH_LARGE_VOCAB.json \
         docs/BENCH_TRANSFER.json docs/BENCH_TPU_TUNE.json \
+        docs/BENCH_MODEL_ZOO.json docs/BENCH_CONVERGENCE_DEVICE.json \
         docs/TPU_WATCHER_LOG.jsonl docs/TPU_SESSION_OUT.log; do
         [[ -e $p ]] && paths+=("$p")
     done
