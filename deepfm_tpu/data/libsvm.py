@@ -109,3 +109,43 @@ def generate_synthetic_ctr(
                 ]
             )
             w.write(serialize_ctr_example(label, ids.tolist(), values.tolist()))
+
+
+def main(argv: list[str] | None = None) -> int:
+    """Module CLI — the runnable-converter parity of the reference's
+    tools/libsvm_to_tfrecord.py (tools:64-76, which hardcoded its paths):
+
+        python -m deepfm_tpu.data.libsvm in.libsvm out.tfrecords \
+            [--pad-to-field-size N]
+        python -m deepfm_tpu.data.libsvm --reverse in.tfrecords out.libsvm
+    """
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser(
+        prog="deepfm_tpu.data.libsvm",
+        description="libsvm <-> TFRecord CTR converter",
+    )
+    p.add_argument("input")
+    p.add_argument("output")
+    p.add_argument("--pad-to-field-size", type=int, default=None)
+    p.add_argument("--reverse", action="store_true",
+                   help="TFRecord -> libsvm text instead")
+    args = p.parse_args(argv)
+    if args.reverse:
+        count = 0
+        with open(args.output, "w") as f:
+            for line in tfrecord_to_libsvm(args.input):
+                f.write(line + "\n")
+                count += 1
+    else:
+        count = libsvm_to_tfrecord(
+            args.input, args.output,
+            pad_to_field_size=args.pad_to_field_size,
+        )
+    print(json.dumps({"records": count, "output": args.output}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

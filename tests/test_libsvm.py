@@ -67,3 +67,30 @@ def test_synthetic_generator(tmp_path):
         assert len(p["ids"]) == 39
         assert p["ids"].max() < 1000
         assert p["ids"].min() >= 0
+
+
+def test_module_cli_round_trip(tmp_path):
+    """python -m deepfm_tpu.data.libsvm — the runnable-converter parity of
+    the reference's tools/libsvm_to_tfrecord.py, paths as arguments."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    src = tmp_path / "in.libsvm"
+    src.write_text("1 1:0.5 14:1\n0 2:0.3 20:1\n")
+    out = tmp_path / "out.tfrecords"
+    back = tmp_path / "back.libsvm"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    r = subprocess.run(
+        [sys.executable, "-m", "deepfm_tpu.data.libsvm", str(src), str(out)],
+        capture_output=True, text=True, env=env, check=True,
+    )
+    assert json.loads(r.stdout)["records"] == 2
+    r = subprocess.run(
+        [sys.executable, "-m", "deepfm_tpu.data.libsvm", "--reverse",
+         str(out), str(back)],
+        capture_output=True, text=True, env=env, check=True,
+    )
+    assert json.loads(r.stdout)["records"] == 2
+    assert back.read_text().splitlines() == ["1 1:0.5 14:1", "0 2:0.3 20:1"]
