@@ -119,20 +119,10 @@ def dense_adam_roofline(platform: str) -> dict:
     return roof
 
 
-def main() -> None:
-    resolve_platform()
-    from deepfm_tpu.core.platform import sanitize_backend
-
-    sanitize_backend()
-    import jax
-
-    from deepfm_tpu.core.platform import is_tpu_backend
-
-    # normalize tunneled TPU plugins that report their own platform name
-    platform = "tpu" if is_tpu_backend() else jax.devices()[0].platform
+def _flagship_cfg(fused: str = "off", lazy: bool = False):
     from deepfm_tpu.core.config import Config
 
-    cfg = Config.from_dict(
+    return Config.from_dict(
         {
             "model": {
                 "feature_size": V,
@@ -140,18 +130,22 @@ def main() -> None:
                 "embedding_size": K,
                 "deep_layers": DEEP,
                 "dropout_keep": (0.5, 0.5, 0.5),
+                "fused_kernel": fused,
             },
-            "optimizer": {"learning_rate": 0.0005},
+            "optimizer": {"learning_rate": 0.0005,
+                          "lazy_embedding_updates": lazy},
             "data": {"batch_size": 1024},
         }
     )
-    batch_size = cfg.data.batch_size
 
-    # synthetic Criteo-shaped batches (13 numeric + 26 skewed categorical),
-    # pre-staged on device so the bench isolates the training-step rate
+
+def _synth_batches(batch_size: int, nb: int = 8, device_put: bool = True):
+    """Synthetic Criteo-shaped batches (13 numeric + 26 skewed categorical),
+    pre-staged on device so the bench isolates the training-step rate."""
+    import jax
+
     rng = np.random.default_rng(0)
-    nb = 8
-    host_batches, batches = [], []
+    out = []
     for _ in range(nb):
         numeric = rng.integers(1, 14, size=(batch_size, 13))
         cat = 14 + (rng.zipf(1.3, size=(batch_size, 26)) % (V - 14))
@@ -162,69 +156,134 @@ def main() -> None:
         )
         labels = (rng.random(batch_size) < 0.25).astype(np.float32)
         hb = {"feat_ids": ids, "feat_vals": vals, "label": labels}
-        host_batches.append(hb)
-        batches.append({k: jax.device_put(v) for k, v in hb.items()})
+        out.append({k: jax.device_put(v) for k, v in hb.items()}
+                   if device_put else hb)
+    return out
 
-    steps = 100
 
-    def _time_loop(step_fn, state, bs) -> tuple[float, float]:
-        for i in range(3):  # warmup (compile + first dispatches)
-            state, metrics = step_fn(state, bs[i % nb])
-        jax.block_until_ready(metrics)
-        t0 = time.perf_counter()
-        for i in range(steps):
-            state, metrics = step_fn(state, bs[i % nb])
-        jax.block_until_ready(metrics)
-        dt = time.perf_counter() - t0
-        return steps * batch_size / dt, float(metrics["loss"])
+STEPS = 100
+BATCH = 1024
 
-    def measure(fused: str, lazy: bool = False) -> tuple[float, float]:
-        from deepfm_tpu.train import create_train_state, make_train_step
 
-        c = cfg.with_overrides(
-            model={"fused_kernel": fused},
-            optimizer={"lazy_embedding_updates": lazy},
-        )
-        state = create_train_state(c)
-        train_step = jax.jit(make_train_step(c), donate_argnums=(0,))
-        return _time_loop(train_step, state, batches)
+def _time_loop(step_fn, state, bs) -> tuple[float, float]:
+    import jax
 
-    def measure_spmd(lazy: bool) -> tuple[float, float]:
-        """The product path: shard_map step on a [1,1] mesh — measures the
-        shard_map/collective overhead vs the plain jit step."""
-        from deepfm_tpu.core.config import MeshConfig
-        from deepfm_tpu.parallel import (
-            build_mesh, create_spmd_state, make_context,
-            make_spmd_train_step, shard_batch,
-        )
+    nb = len(bs)
+    batch_size = int(bs[0]["label"].shape[0])
+    for i in range(3):  # warmup (compile + first dispatches)
+        state, metrics = step_fn(state, bs[i % nb])
+    jax.block_until_ready(metrics)
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        state, metrics = step_fn(state, bs[i % nb])
+    jax.block_until_ready(metrics)
+    dt = time.perf_counter() - t0
+    return STEPS * batch_size / dt, float(metrics["loss"])
 
-        c = cfg.with_overrides(
-            mesh={"data_parallel": 1, "model_parallel": 1},
-            optimizer={"lazy_embedding_updates": lazy},
-        )
-        mesh = build_mesh(MeshConfig(data_parallel=1, model_parallel=1))
-        ctx = make_context(c, mesh)
-        state = create_spmd_state(ctx)
-        step_fn = make_spmd_train_step(ctx)  # donated, jitted inside
-        sb = [shard_batch(ctx, hb, validate_ids=False) for hb in host_batches]
-        return _time_loop(step_fn, state, sb)
+
+def measure(fused: str, lazy: bool = False) -> tuple[float, float]:
+    import jax
+
+    from deepfm_tpu.train import create_train_state, make_train_step
+
+    c = _flagship_cfg(fused, lazy)
+    state = create_train_state(c)
+    train_step = jax.jit(make_train_step(c), donate_argnums=(0,))
+    return _time_loop(train_step, state, _synth_batches(BATCH))
+
+
+def measure_spmd(lazy: bool) -> tuple[float, float]:
+    """The product path: shard_map step on a [1,1] mesh — measures the
+    shard_map/collective overhead vs the plain jit step."""
+    from deepfm_tpu.core.config import MeshConfig
+    from deepfm_tpu.parallel import (
+        build_mesh, create_spmd_state, make_context,
+        make_spmd_train_step, shard_batch,
+    )
+
+    c = _flagship_cfg("off", lazy).with_overrides(
+        mesh={"data_parallel": 1, "model_parallel": 1},
+    )
+    mesh = build_mesh(MeshConfig(data_parallel=1, model_parallel=1))
+    ctx = make_context(c, mesh)
+    state = create_spmd_state(ctx)
+    step_fn = make_spmd_train_step(ctx)  # donated, jitted inside
+    sb = [shard_batch(ctx, hb, validate_ids=False)
+          for hb in _synth_batches(BATCH, device_put=False)]
+    return _time_loop(step_fn, state, sb)
+
+
+VARIANTS = {
+    "xla": lambda: measure("off"),
+    "pallas_fused": lambda: measure("on", False),
+    "lazy_adam": lambda: measure("off", True),
+    "spmd_xla": lambda: measure_spmd(False),
+    "spmd_lazy": lambda: measure_spmd(True),
+}
+
+
+def run_variant(name: str) -> None:
+    """Child mode (--variant NAME): measure one variant in THIS process and
+    print its JSON row.  Variants are isolated in subprocesses because
+    in-process sequential measurement cross-contaminates on the tunneled
+    backend (round 3: lazy_adam measured 144k ex/s after three prior
+    variants in one process vs 6.9M ex/s isolated, docs/BENCH_TPU_TUNE.json)."""
+    from deepfm_tpu.core.platform import sanitize_backend
+
+    sanitize_backend()
+    rate, loss = VARIANTS[name]()
+    print(json.dumps({"variant": name, "examples_per_sec": rate,
+                      "final_loss": loss}))
+
+
+def main() -> None:
+    if len(sys.argv) > 2 and sys.argv[1] == "--variant":
+        # child: platform was resolved by the parent and passed via env
+        run_variant(sys.argv[2])
+        return
+
+    resolve_platform()
+    from deepfm_tpu.core.platform import sanitize_backend
+
+    sanitize_backend()
 
     # auto-tune: XLA gather vs Pallas fused gather vs lazy (touched-rows)
-    # Adam — report the fastest, record all (missing key flags a breakage)
-    rates = {"xla": measure("off")}
-    variants = [("lazy_adam", lambda: measure("off", True)),
-                ("spmd_xla", lambda: measure_spmd(False)),
-                ("spmd_lazy", lambda: measure_spmd(True))]
-    if platform == "tpu":
-        variants.insert(0, ("pallas_fused", lambda: measure("on", False)))
-    for name, fn in variants:
+    # Adam vs the shard_map product path — each in an isolated subprocess;
+    # report the fastest, record all (a missing key flags a breakage)
+    from deepfm_tpu.core.platform import _TUNNEL_PLATFORMS
+
+    platform_req = os.environ["JAX_PLATFORMS"]
+    # the parent resolved the platform WITHOUT initializing jax on the
+    # tunneled backend (probe ran in a subprocess), so children don't
+    # contend with a parent-held client; they inherit the resolved env
+    platform = "tpu" if platform_req in _TUNNEL_PLATFORMS else platform_req
+    names = [n for n in VARIANTS
+             if n != "pallas_fused" or platform == "tpu"]
+    rates: dict[str, tuple[float, float]] = {}
+    for name in names:
         try:
-            rates[name] = fn()
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--variant", name],
+                capture_output=True, text=True,
+                timeout=int(os.environ.get("DEEPFM_BENCH_VARIANT_TIMEOUT",
+                                           "600")),
+            )
+            if r.returncode == 0 and r.stdout.strip():
+                row = json.loads(r.stdout.strip().splitlines()[-1])
+                rates[name] = (row["examples_per_sec"], row["final_loss"])
+            else:
+                print(f"{name} variant failed: {(r.stderr or 'no output')[-200:]}",
+                      file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"{name} variant timed out", file=sys.stderr)
         except Exception as e:
             print(f"{name} variant failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
+    if not rates:
+        raise RuntimeError("every bench variant failed")
     best = max(rates, key=lambda k: rates[k][0])
     examples_per_sec, final_loss = rates[best]
+    batch_size = BATCH
     result = {
         "metric": "deepfm_train_examples_per_sec_per_chip",
         "value": round(examples_per_sec, 1),
@@ -235,7 +294,7 @@ def main() -> None:
         "vs_baseline_valid": platform == "tpu",
         "platform": platform,
         "batch_size": batch_size,
-        "steps": steps,
+        "steps": STEPS,
         "step_ms": round(1000 * batch_size / examples_per_sec, 3),
         "final_loss": round(final_loss, 4),
         "variant": best,

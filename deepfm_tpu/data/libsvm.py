@@ -133,9 +133,18 @@ def main(argv: list[str] | None = None) -> int:
                    help="TFRecord -> libsvm text instead")
     args = p.parse_args(argv)
     if args.reverse:
+        if args.pad_to_field_size is not None:
+            p.error("--pad-to-field-size applies to libsvm->TFRecord only")
+        # pull the first record BEFORE opening the output so a bad input
+        # path can't truncate an existing output file
+        lines = tfrecord_to_libsvm(args.input)
+        first = next(lines, None)
         count = 0
         with open(args.output, "w") as f:
-            for line in tfrecord_to_libsvm(args.input):
+            if first is not None:
+                f.write(first + "\n")
+                count = 1
+            for line in lines:
                 f.write(line + "\n")
                 count += 1
     else:
