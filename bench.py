@@ -26,6 +26,8 @@ Measured variants:
   pallas_fused  Pallas fused gather+FM kernel (TPU only)
   spmd_xla      the PRODUCT path: shard_map train step on a 1-chip mesh
   spmd_lazy     sharded lazy-Adam step on a 1-chip mesh
+  spmd_scan8    the product path with run.steps_per_loop=8: K steps fused
+                into one scanned dispatch + one stacked transfer
 """
 
 from __future__ import annotations
@@ -169,7 +171,8 @@ def _time_loop(step_fn, state, bs) -> tuple[float, float]:
     import jax
 
     nb = len(bs)
-    batch_size = int(bs[0]["label"].shape[0])
+    # examples per dispatch: [B] single-step or [K, B] stacked-scan batches
+    batch_size = int(np.prod(bs[0]["label"].shape))
     for i in range(3):  # warmup (compile + first dispatches)
         state, metrics = step_fn(state, bs[i % nb])
     jax.block_until_ready(metrics)
@@ -178,7 +181,9 @@ def _time_loop(step_fn, state, bs) -> tuple[float, float]:
         state, metrics = step_fn(state, bs[i % nb])
     jax.block_until_ready(metrics)
     dt = time.perf_counter() - t0
-    return STEPS * batch_size / dt, float(metrics["loss"])
+    # scan variants return stacked [K] metrics; report the last sub-step
+    final_loss = float(np.asarray(metrics["loss"]).reshape(-1)[-1])
+    return STEPS * batch_size / dt, final_loss
 
 
 def measure(fused: str, lazy: bool = False) -> tuple[float, float]:
@@ -192,13 +197,15 @@ def measure(fused: str, lazy: bool = False) -> tuple[float, float]:
     return _time_loop(train_step, state, _synth_batches(BATCH))
 
 
-def measure_spmd(lazy: bool) -> tuple[float, float]:
+def measure_spmd(lazy: bool, steps_per_loop: int = 1) -> tuple[float, float]:
     """The product path: shard_map step on a [1,1] mesh — measures the
-    shard_map/collective overhead vs the plain jit step."""
+    shard_map/collective overhead vs the plain jit step.  With
+    ``steps_per_loop > 1``, K optimizer steps fuse into one scanned dispatch
+    with one stacked transfer (run.steps_per_loop; parallel/spmd.py)."""
     from deepfm_tpu.core.config import MeshConfig
     from deepfm_tpu.parallel import (
-        build_mesh, create_spmd_state, make_context,
-        make_spmd_train_step, shard_batch,
+        build_mesh, create_spmd_state, make_context, make_spmd_train_loop,
+        make_spmd_train_step, shard_batch, shard_batch_stacked,
     )
 
     c = _flagship_cfg("off", lazy).with_overrides(
@@ -207,9 +214,16 @@ def measure_spmd(lazy: bool) -> tuple[float, float]:
     mesh = build_mesh(MeshConfig(data_parallel=1, model_parallel=1))
     ctx = make_context(c, mesh)
     state = create_spmd_state(ctx)
+    host = _synth_batches(BATCH, device_put=False)
+    if steps_per_loop > 1:
+        k = steps_per_loop
+        step_fn = make_spmd_train_loop(ctx, k)
+        sb = [shard_batch_stacked(ctx, host[i:i + k], validate_ids=False)
+              for i in range(0, len(host), k)]
+        rate, loss = _time_loop(step_fn, state, sb)
+        return rate, loss
     step_fn = make_spmd_train_step(ctx)  # donated, jitted inside
-    sb = [shard_batch(ctx, hb, validate_ids=False)
-          for hb in _synth_batches(BATCH, device_put=False)]
+    sb = [shard_batch(ctx, hb, validate_ids=False) for hb in host]
     return _time_loop(step_fn, state, sb)
 
 
@@ -219,6 +233,7 @@ VARIANTS = {
     "lazy_adam": lambda: measure("off", True),
     "spmd_xla": lambda: measure_spmd(False),
     "spmd_lazy": lambda: measure_spmd(True),
+    "spmd_scan8": lambda: measure_spmd(False, steps_per_loop=8),
 }
 
 

@@ -11,9 +11,11 @@ from .spmd import (  # noqa: F401
     make_context,
     make_spmd_eval_step,
     make_spmd_predict_step,
+    make_spmd_train_loop,
     make_spmd_train_step,
     padded_vocab,
     shard_batch,
+    shard_batch_stacked,
 )
 from .retrieval import (  # noqa: F401
     RetrievalContext,

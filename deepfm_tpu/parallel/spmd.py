@@ -227,17 +227,25 @@ def _local_loss(cfg: Config, model, params, model_state, batch, rng, train):
     return loss, (ce, logits, new_state)
 
 
-def make_spmd_train_step(ctx: SPMDContext, *, donate: bool = True) -> Callable:
-    """``(state, batch) -> (state, metrics)`` — fully sharded and jitted.
+_TRAIN_METRIC_SPECS = {
+    "loss": P(),
+    "ce": P(),
+    "pred_mean": P(),
+    "label_mean": P(),
+    "loss_per_shard": P(DATA_AXIS),
+}
 
-    The batch must be globally-batched arrays placed with
-    ``ctx.batch_shardings`` (see ``shard_batch``).
-    """
+
+def _build_local_train_step(ctx: SPMDContext) -> Callable:
+    """The per-shard ``(state, batch) -> (state, metrics)`` body (dense or
+    lazy by config) — shared by the one-step dispatcher
+    (``make_spmd_train_step``) and the scanned multi-step loop
+    (``make_spmd_train_loop``).  Metrics follow ``_TRAIN_METRIC_SPECS``."""
     cfg = ctx.cfg
     model = get_model(cfg.model)
     tx = build_optimizer(cfg.optimizer, data_parallel_size=cfg.mesh.data_parallel)
     if cfg.optimizer.lazy_embedding_updates:
-        return _make_lazy_spmd_train_step(ctx, model, tx, donate=donate)
+        return _build_lazy_local_step(ctx, model, tx)
 
     def local_step(state: TrainState, batch: dict):
         # distinct dropout mask per data shard, identical across model shards
@@ -276,27 +284,65 @@ def make_spmd_train_step(ctx: SPMDContext, *, donate: bool = True) -> Callable:
         )
         return new_state, metrics
 
-    metric_specs = {
-        "loss": P(),
-        "ce": P(),
-        "pred_mean": P(),
-        "label_mean": P(),
-        "loss_per_shard": P(DATA_AXIS),
-    }
+    return local_step
+
+
+def make_spmd_train_step(ctx: SPMDContext, *, donate: bool = True) -> Callable:
+    """``(state, batch) -> (state, metrics)`` — fully sharded and jitted.
+
+    The batch must be globally-batched arrays placed with
+    ``ctx.batch_shardings`` (see ``shard_batch``).
+    """
     mapped = shard_map(
-        local_step,
+        _build_local_train_step(ctx),
         mesh=ctx.mesh,
         in_specs=(ctx.state_specs, ctx.batch_specs),
-        out_specs=(ctx.state_specs, metric_specs),
+        out_specs=(ctx.state_specs, _TRAIN_METRIC_SPECS),
         check_vma=False,  # grads of psum-assembled lookups defeat replication checking
     )
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
 
-def _make_lazy_spmd_train_step(
-    ctx: SPMDContext, model, tx, *, donate: bool
+def _stack_leading(spec: P) -> P:
+    return P(*((None,) + tuple(spec)))
+
+
+def make_spmd_train_loop(
+    ctx: SPMDContext, steps_per_loop: int, *, donate: bool = True
 ) -> Callable:
-    """Sharded lazy-Adam train step (train/lazy.py, SPMD edition).
+    """``(state, stacked_batch) -> (state, stacked_metrics)`` — K optimizer
+    steps fused into ONE compiled dispatch via ``lax.scan`` inside the
+    sharded program (the standard TPU host-loop design).  The stacked batch
+    is ``[K, ...]``-leading arrays placed with ``shard_batch_stacked``;
+    metrics come back stacked ``[K]`` per key.  Step-for-step equivalent to
+    K sequential ``make_spmd_train_step`` dispatches (the per-step dropout
+    rng folds ``state.step``, which advances inside the scan) — asserted in
+    tests/test_train_scan.py."""
+    if steps_per_loop < 1:
+        raise ValueError(f"steps_per_loop must be >= 1, got {steps_per_loop}")
+    local_step = _build_local_train_step(ctx)
+
+    def local_loop(state: TrainState, stacked: dict):
+        return lax.scan(local_step, state, stacked)
+
+    stacked_batch_specs = {
+        k: _stack_leading(s) for k, s in ctx.batch_specs.items()
+    }
+    stacked_metric_specs = {
+        k: _stack_leading(s) for k, s in _TRAIN_METRIC_SPECS.items()
+    }
+    mapped = shard_map(
+        local_loop,
+        mesh=ctx.mesh,
+        in_specs=(ctx.state_specs, stacked_batch_specs),
+        out_specs=(ctx.state_specs, stacked_metric_specs),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def _build_lazy_local_step(ctx: SPMDContext, model, tx) -> Callable:
+    """Per-shard lazy-Adam step body (train/lazy.py, SPMD edition).
 
     The gradient is taken w.r.t. the psum-ASSEMBLED rows, so no dense table
     gradient (or its data-axis pmean — the dominant ICI cost at large vocab)
@@ -413,21 +459,7 @@ def _make_lazy_spmd_train_step(
         )
         return new_state, metrics
 
-    metric_specs = {
-        "loss": P(),
-        "ce": P(),
-        "pred_mean": P(),
-        "label_mean": P(),
-        "loss_per_shard": P(DATA_AXIS),
-    }
-    mapped = shard_map(
-        local_step,
-        mesh=ctx.mesh,
-        in_specs=(ctx.state_specs, ctx.batch_specs),
-        out_specs=(ctx.state_specs, metric_specs),
-        check_vma=False,
-    )
-    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+    return local_step
 
 
 def make_spmd_eval_step(ctx: SPMDContext) -> Callable:
@@ -517,6 +549,30 @@ def make_spmd_predict_step(ctx: SPMDContext) -> Callable:
     return jax.jit(mapped)
 
 
+def _validate_local_batch(ctx: SPMDContext, b: int, ids) -> int:
+    """Shared batch checks for both placers: per-(process-)data-parallel
+    divisibility and (when ``ids`` is given) the true-vocab range guard.
+    Returns ``jax.process_count()``."""
+    dp, _ = mesh_shape(ctx.mesh)
+    nproc = jax.process_count()
+    local_dp = max(1, dp // nproc)
+    if b % local_dp != 0:
+        raise ValueError(
+            f"{'local' if nproc > 1 else 'global'} batch {b} not divisible "
+            f"by {'per-process ' if nproc > 1 else ''}data_parallel {local_dp}"
+        )
+    if ids is not None:
+        import numpy as np
+
+        ids = np.asarray(ids)
+        if ids.size and (ids.min() < 0 or ids.max() >= ctx.true_feature_size):
+            raise ValueError(
+                f"feat_ids out of range [0, {ctx.true_feature_size}): "
+                f"min={ids.min()} max={ids.max()}"
+            )
+    return nproc
+
+
 def shard_batch(ctx: SPMDContext, batch: dict, *, validate_ids: bool = True) -> dict:
     """Place a host batch onto the mesh (data-sharded, model-replicated).
 
@@ -537,24 +593,10 @@ def shard_batch(ctx: SPMDContext, batch: dict, *, validate_ids: bool = True) -> 
     instead.  Set ``validate_ids=False`` on a hot path that has already
     validated.
     """
-    dp, _ = mesh_shape(ctx.mesh)
-    nproc = jax.process_count()
-    b = batch["label"].shape[0]
-    local_dp = max(1, dp // nproc)
-    if b % local_dp != 0:
-        raise ValueError(
-            f"{'local' if nproc > 1 else 'global'} batch {b} not divisible "
-            f"by {'per-process ' if nproc > 1 else ''}data_parallel {local_dp}"
-        )
-    if validate_ids and "feat_ids" in batch:
-        import numpy as np
-
-        ids = np.asarray(batch["feat_ids"])
-        if ids.size and (ids.min() < 0 or ids.max() >= ctx.true_feature_size):
-            raise ValueError(
-                f"feat_ids out of range [0, {ctx.true_feature_size}): "
-                f"min={ids.min()} max={ids.max()}"
-            )
+    nproc = _validate_local_batch(
+        ctx, batch["label"].shape[0],
+        batch.get("feat_ids") if validate_ids else None,
+    )
     if nproc > 1:
         import numpy as np
 
@@ -567,3 +609,34 @@ def shard_batch(ctx: SPMDContext, batch: dict, *, validate_ids: bool = True) -> 
     return {
         k: jax.device_put(batch[k], ctx.batch_shardings[k]) for k in batch
     }
+
+
+def shard_batch_stacked(
+    ctx: SPMDContext, batches: list[dict], *, validate_ids: bool = True
+) -> dict:
+    """Stack K host batches into ``[K, ...]``-leading arrays and place them
+    for ``make_spmd_train_loop`` — ONE host->device transfer per K steps
+    instead of K (the transfer-amortization half of ``run.steps_per_loop``;
+    the dispatch-amortization half is the scan).  Same single-/multi-process
+    semantics and id validation as ``shard_batch``."""
+    import numpy as np
+
+    stacked = {
+        k: np.stack([np.asarray(b[k]) for b in batches]) for k in batches[0]
+    }
+    nproc = _validate_local_batch(
+        ctx, stacked["label"].shape[1],
+        stacked.get("feat_ids") if validate_ids else None,
+    )
+    shardings = {
+        k: NamedSharding(
+            ctx.mesh, P(*((None,) + tuple(ctx.batch_specs[k])))
+        )
+        for k in stacked
+    }
+    if nproc > 1:
+        return {
+            k: jax.make_array_from_process_local_data(shardings[k], stacked[k])
+            for k in stacked
+        }
+    return {k: jax.device_put(stacked[k], shardings[k]) for k in stacked}

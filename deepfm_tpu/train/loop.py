@@ -39,8 +39,10 @@ from ..parallel import (
     make_context,
     make_spmd_eval_step,
     make_spmd_predict_step,
+    make_spmd_train_loop,
     make_spmd_train_step,
     shard_batch,
+    shard_batch_stacked,
 )
 from ..serve import export_servable, write_predictions
 from ..train.step import TrainState
@@ -94,8 +96,33 @@ def _train_batches(
         # (live FIFO, fresh data) ignores the skip inside make_input_pipeline
         skip_batches=skip_batches,
     )
+    k = max(1, cfg.run.steps_per_loop)
+    if k == 1:
+        return DevicePrefetcher(
+            batches, lambda b: shard_batch(ctx, b), depth=cfg.data.prefetch_batches
+        )
+
+    # steps_per_loop: group K host batches -> ONE stacked transfer + ONE
+    # K-step scan dispatch.  The stream tail (< K batches left) falls back
+    # to single-step items so no record is dropped or duplicated.
+    def chunked(it):
+        buf = []
+        for b in it:
+            buf.append(b)
+            if len(buf) == k:
+                yield ("stack", buf)
+                buf = []
+        for b in buf:
+            yield ("one", b)
+
+    def place(item):
+        tag, payload = item
+        if tag == "stack":
+            return tag, shard_batch_stacked(ctx, payload)
+        return tag, shard_batch(ctx, payload)
+
     return DevicePrefetcher(
-        batches, lambda b: shard_batch(ctx, b), depth=cfg.data.prefetch_batches
+        chunked(batches), place, depth=cfg.data.prefetch_batches
     )
 
 
@@ -259,6 +286,10 @@ def run_train(cfg: Config) -> TrainState:
         state = restore_latest(ckpt, ctx, state, log)
         log.event("resume", step=int(state.step))
     train_step = make_spmd_train_step(ctx)
+    steps_per_loop = max(1, cfg.run.steps_per_loop)
+    loop_step = (
+        make_spmd_train_loop(ctx, steps_per_loop) if steps_per_loop > 1 else None
+    )
 
     profile_cm = (
         jax.profiler.trace(cfg.run.profile_dir)
@@ -268,6 +299,7 @@ def run_train(cfg: Config) -> TrainState:
     # host-side step counter: int(state.step) every iteration would block on
     # the just-dispatched step and defeat async-dispatch pipelining
     step = int(state.step)
+    log.seed_step(step)
     guard = PreemptionGuard()
     # periodic in-training eval, the train_and_evaluate cadence (ps:510-520):
     # no eval before start_delay, then at most one per throttle interval.
@@ -277,16 +309,32 @@ def run_train(cfg: Config) -> TrainState:
     t_start = time.time()
     next_eval = t_start + max(cfg.run.eval_start_delay_secs, cfg.run.eval_throttle_secs)
     cpu_serial = _cpu_serialize_dispatch()
+    ckpt_every = cfg.run.checkpoint_every_steps
     with profile_cm, guard, _train_batches(cfg, ctx, skip_batches=step) as batches:
-        for batch in batches:
-            batch_size = int(batch["label"].shape[0])
-            state, metrics = train_step(state, batch)
+        for item in batches:
+            if steps_per_loop > 1:
+                tag, batch = item
+            else:
+                tag, batch = "one", item
+            if tag == "stack":
+                # K fused optimizer steps; metrics come back stacked [K] —
+                # log the last sub-step's values (no extra device sync)
+                state, stacked_metrics = loop_step(state, batch)
+                metrics = {k: v[-1] for k, v in stacked_metrics.items()}
+                inc = steps_per_loop
+                batch_size = int(batch["label"].shape[1]) * inc
+            else:
+                state, metrics = train_step(state, batch)
+                inc = 1
+                batch_size = int(batch["label"].shape[0])
             if cpu_serial:
                 jax.block_until_ready(metrics)
-            step += 1
+            step += inc
             log.step(step, batch_size, {k: v for k, v in metrics.items()
                                         if k != "loss_per_shard"})
-            if cfg.run.checkpoint_every_steps and step % cfg.run.checkpoint_every_steps == 0:
+            # boundary-crossing test: a K-step dispatch may jump past the
+            # exact multiple (identical to `step % N == 0` when inc == 1)
+            if ckpt_every and step // ckpt_every > (step - inc) // ckpt_every:
                 ckpt.save(state)
             if eval_enabled and time.time() >= next_eval:
                 run_eval(cfg, ctx, state, log)
@@ -432,6 +480,7 @@ def run_retrieval_train(cfg: Config) -> TrainState:
         num_epochs=cfg.data.num_epochs, shuffle=True,
     )
     step = int(state.step)
+    log.seed_step(step)
     if step:
         # input-position resume (same contract as _train_batches): the
         # ratings batch stream is seed-deterministic, so skip what the

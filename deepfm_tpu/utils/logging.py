@@ -30,12 +30,22 @@ class MetricLogger:
         self._prefix = prefix
         self._t_last = time.perf_counter()
         self._examples_since = 0
-        self._steps_since = 0
+        self._step_at_last_log = 0
+
+    def seed_step(self, step: int) -> None:
+        """Anchor the logger at a resumed step so the first post-resume log
+        fires on the next boundary with a correct per-step time (without
+        this, step_ms divides elapsed time by the absolute step count)."""
+        self._step_at_last_log = step
+        self._t_last = time.perf_counter()
 
     def step(self, step: int, batch_size: int, metrics: Mapping[str, Any]) -> None:
+        """``batch_size`` = examples consumed since the previous call (K·B
+        when a multi-step dispatch advanced ``step`` by K).  Logs whenever a
+        ``log_steps`` boundary was crossed since the last log — robust to
+        step increments that never land exactly on a multiple."""
         self._examples_since += batch_size
-        self._steps_since += 1
-        if step % self.log_steps:
+        if step // self.log_steps <= self._step_at_last_log // self.log_steps:
             return
         now = time.perf_counter()
         dt = max(now - self._t_last, 1e-9)
@@ -43,7 +53,10 @@ class MetricLogger:
             "kind": self._prefix,
             "step": int(step),
             "examples_per_sec": round(self._examples_since / dt, 1),
-            "step_ms": round(1000 * dt / self._steps_since, 3),
+            # per OPTIMIZER step (a multi-step dispatch advances `step` by K)
+            "step_ms": round(
+                1000 * dt / max(1, step - self._step_at_last_log), 3
+            ),
         }
         for k, v in metrics.items():
             try:
@@ -53,7 +66,7 @@ class MetricLogger:
         self._emit(record)
         self._t_last = now
         self._examples_since = 0
-        self._steps_since = 0
+        self._step_at_last_log = step
 
     def event(self, kind: str, **fields: Any) -> None:
         record: dict[str, Any] = {"kind": kind}

@@ -1,0 +1,158 @@
+"""The multi-step scan train loop (run.steps_per_loop) on the virtual mesh.
+
+``make_spmd_train_loop(ctx, K)`` fuses K optimizer steps into one compiled
+dispatch (lax.scan inside the sharded program) with one stacked transfer
+(``shard_batch_stacked``).  The load-bearing invariant: a K-step dispatch is
+step-for-step IDENTICAL to K sequential ``make_spmd_train_step`` dispatches
+— same parameters, same per-step metrics — because the per-step dropout rng
+folds ``state.step``, which advances inside the scan exactly as it does
+between dispatches.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from deepfm_tpu.core.config import Config, MeshConfig
+from deepfm_tpu.parallel import (
+    build_mesh,
+    create_spmd_state,
+    make_context,
+    make_spmd_train_loop,
+    make_spmd_train_step,
+    shard_batch,
+    shard_batch_stacked,
+)
+
+from test_spmd import CFG, _batch, _mesh
+
+K = 3  # sub-steps per fused dispatch in these tests
+
+
+def _host_batches(cfg, n, b=16, seed=0):
+    keys = jax.random.split(jax.random.PRNGKey(seed), n)
+    return [_batch(k, b, cfg) for k in keys]
+
+
+@pytest.mark.parametrize("lazy", [False, True], ids=["dense", "lazy"])
+@pytest.mark.parametrize("dp,mp", [(2, 4), (8, 1)])
+def test_scan_loop_matches_sequential(dp, mp, lazy):
+    cfg = CFG.with_overrides(
+        mesh={"data_parallel": dp, "model_parallel": mp},
+        optimizer={"lazy_embedding_updates": lazy},
+    )
+    mesh = _mesh(dp, mp)
+    ctx = make_context(cfg, mesh)
+    batches = _host_batches(cfg, K)
+
+    seq_state = create_spmd_state(ctx)
+    step_fn = make_spmd_train_step(ctx, donate=False)
+    seq_metrics = []
+    for hb in batches:
+        seq_state, m = step_fn(seq_state, shard_batch(ctx, hb))
+        seq_metrics.append(m)
+
+    scan_state = create_spmd_state(ctx)
+    loop_fn = make_spmd_train_loop(ctx, K, donate=False)
+    scan_state, stacked = loop_fn(scan_state, shard_batch_stacked(ctx, batches))
+
+    assert int(scan_state.step) == int(seq_state.step) == K
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6),
+        jax.device_get(scan_state.params),
+        jax.device_get(seq_state.params),
+    )
+    for i in range(K):
+        for key in ("loss", "ce", "pred_mean"):
+            np.testing.assert_allclose(
+                float(stacked[key][i]), float(seq_metrics[i][key]),
+                rtol=1e-5, atol=1e-6,
+                err_msg=f"metric {key} sub-step {i}",
+            )
+    # per-shard losses stack as [K, dp]
+    assert stacked["loss_per_shard"].shape == (K, dp)
+
+
+def test_stacked_batch_validation():
+    cfg = CFG.with_overrides(mesh={"data_parallel": 2, "model_parallel": 4})
+    ctx = make_context(cfg, _mesh(2, 4))
+    batches = _host_batches(cfg, 2)
+    bad = {**batches[1], "feat_ids": batches[1]["feat_ids"] + 10_000}
+    with pytest.raises(ValueError, match="out of range"):
+        shard_batch_stacked(ctx, [batches[0], bad])
+    with pytest.raises(ValueError, match="not divisible"):
+        shard_batch_stacked(
+            ctx, [{k: v[:3] for k, v in b.items()} for b in batches]
+        )
+
+
+def test_run_train_steps_per_loop_end_to_end(tmp_path):
+    """run_train with steps_per_loop=2: full lifecycle incl. a stream tail
+    (odd batch count), checkpointing on a crossed boundary, and eval."""
+    from deepfm_tpu.data.libsvm import generate_synthetic_ctr
+    from deepfm_tpu.train.loop import run_train
+
+    data = tmp_path / "data"
+    data.mkdir()
+    # 5 batches of 16 per epoch -> 2 stacked dispatches + 1 tail step
+    generate_synthetic_ctr(data / "tr-0.tfrecords", num_records=80,
+                           feature_size=117, field_size=6, seed=0)
+    generate_synthetic_ctr(data / "va-0.tfrecords", num_records=32,
+                           feature_size=117, field_size=6, seed=1)
+    cfg = CFG.with_overrides(
+        mesh={"data_parallel": 8, "model_parallel": 1},
+        data={
+            "training_data_dir": str(data),
+            "val_data_dir": str(data),
+            "batch_size": 16,
+            "num_epochs": 2,
+        },
+        run={
+            "model_dir": str(tmp_path / "model"),
+            "servable_model_dir": "",
+            "steps_per_loop": 2,
+            "checkpoint_every_steps": 4,   # falls between 2-step dispatches
+            "log_steps": 2,
+        },
+    )
+    state = run_train(cfg)
+    assert int(state.step) == 10  # 5 batches x 2 epochs
+    from deepfm_tpu.checkpoint import Checkpointer
+
+    ckpt = Checkpointer(str(tmp_path / "model"))
+    # the crossed boundary at step 4/8 plus the final save at step 10
+    assert ckpt.latest_step() == 10
+    ckpt.close()
+
+
+def test_metric_logger_multi_step_and_resume():
+    """The logger must fire on crossed log_steps boundaries even when step
+    advances by K per call, report per-OPTIMIZER-step time, and — after a
+    resume seed — not divide elapsed time by the absolute step count."""
+    import io
+    import json as _json
+
+    from deepfm_tpu.utils import MetricLogger
+
+    buf = io.StringIO()
+    log = MetricLogger(log_steps=10, stream=buf)
+    for s in range(4, 44 + 1, 4):      # K=4 increments: 4, 8, ..., 44
+        log.step(s, 4 * 16, {"loss": 0.5})
+    lines = [_json.loads(x) for x in buf.getvalue().splitlines()]
+    # boundaries 10/20/30/40 first crossed at steps 12, 20, 32, 40
+    assert [r["step"] for r in lines] == [12, 20, 32, 40]
+    # 3 dispatches x 16 examples x 4 sub-steps between logs at steady state
+    assert lines[1]["examples_per_sec"] > 0
+
+    buf2 = io.StringIO()
+    log2 = MetricLogger(log_steps=10, stream=buf2)
+    log2.seed_step(5000)               # checkpoint resume at step 5000
+    log2.step(5004, 64, {"loss": 0.4})  # same boundary bucket: no log
+    assert buf2.getvalue() == ""
+    log2.step(5012, 64, {"loss": 0.4})
+    (rec,) = [_json.loads(x) for x in buf2.getvalue().splitlines()]
+    assert rec["step"] == 5012
+    # per-step time divides by 12 steps since the seed, not by 5012
+    assert rec["step_ms"] * 12 == pytest.approx(
+        rec["step_ms"] * (5012 - 5000), rel=1e-6
+    )
