@@ -224,6 +224,52 @@ def main() -> None:
         )
         result["end_to_end_file_ex_per_sec"] = round(rate, 1)
 
+        # --- end to end, file mode, steps_per_loop=8 ----------------------
+        # the multi-step scan loop on the REAL feed: K batches stacked into
+        # one transfer + one fused dispatch (run.steps_per_loop semantics);
+        # quantifies dispatch/transfer amortization at the system level
+        def run_e2e_scan(batch_iter, k: int = 8) -> float:
+            from deepfm_tpu.core.config import MeshConfig
+            from deepfm_tpu.parallel import (
+                build_mesh, create_spmd_state, make_context,
+                make_spmd_train_loop, shard_batch_stacked,
+            )
+
+            c = cfg.with_overrides(
+                mesh={"data_parallel": 1, "model_parallel": 1}
+            )
+            mesh = build_mesh(MeshConfig(data_parallel=1, model_parallel=1))
+            ctx = make_context(c, mesh)
+            st = create_spmd_state(ctx)
+            fn = make_spmd_train_loop(ctx, k)
+
+            def chunks(it):
+                buf = []
+                for b in it:
+                    buf.append(b)
+                    if len(buf) == k:
+                        yield buf
+                        buf = []
+
+            n = 0
+            t0 = time.perf_counter()
+            mm = None
+            with DevicePrefetcher(
+                chunks(batch_iter),
+                lambda bs: shard_batch_stacked(ctx, bs, validate_ids=False),
+                depth=2,
+            ) as pf:
+                for b in pf:
+                    st, mm = fn(st, b)
+                    n += BATCH * k
+            jax.block_until_ready(mm)
+            return n / (time.perf_counter() - t0)
+
+        rate = run_e2e_scan(
+            ctr_batches_from_sources(files, batch_size=BATCH, field_size=F)
+        )
+        result["end_to_end_file_scan8_ex_per_sec"] = round(rate, 1)
+
         # --- end to end, FIFO (pipe) mode --------------------------------
         fifo = os.path.join(tmp, "training")
         os.mkfifo(fifo)
