@@ -176,6 +176,8 @@ class RunConfig:
     # worth ~2x at reference batch sizes where dispatch latency rivals the
     # 135 us on-chip step.  1 = step-per-dispatch (reference-equivalent
     # cadence).  Checkpoint/eval/logging granularity becomes K steps.
+    # Applies to the CTR train task (train/loop.run_train); the retrieval
+    # family keeps step-per-dispatch.
     steps_per_loop: int = 1
     eval_start_delay_secs: int = 0    # reference: 1000 (ps:517); 0 = eval immediately
     eval_throttle_secs: int = 0       # reference: 1200 (ps:519)

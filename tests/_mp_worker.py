@@ -104,6 +104,34 @@ def main() -> None:
     assert int(state2.step) == 5
     ck.close()
 
+    # multi-step scan loop across processes: one 2-step fused dispatch
+    # (stacked per-process placement via make_array_from_process_local_data)
+    # must equal 2 sequential dispatches from the same state
+    from deepfm_tpu.parallel import make_spmd_train_loop, shard_batch_stacked
+
+    gbs = []
+    for _ in range(2):
+        gb2 = {
+            "feat_ids": rng.integers(0, 117, size=(GB, 6)),
+            "feat_vals": rng.normal(size=(GB, 6)).astype(np.float32),
+            "label": (rng.random(GB) < 0.3).astype(np.float32),
+        }
+        gbs.append({k: v[lo:hi] for k, v in gb2.items()})
+    seq = state2
+    for lb in gbs:
+        seq, _ = step_fn(seq, shard_batch(ctx, lb))
+    loop_fn = make_spmd_train_loop(ctx, 2, donate=False)
+    fused, fused_metrics = loop_fn(state2, shard_batch_stacked(ctx, gbs))
+    assert int(fused.step) == int(seq.step) == 7
+    assert fused_metrics["loss"].shape == (2,)
+    for a, b in zip(
+        fused.params["fm_v"].addressable_shards,
+        seq.params["fm_v"].addressable_shards,
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a.data), np.asarray(b.data), rtol=1e-6, atol=1e-6
+        )
+
     # export once: config.json written by process 0 only, params saved
     # collectively (serve/export.py:44 gate)
     from deepfm_tpu.serve import export_servable
