@@ -275,7 +275,16 @@ def main() -> None:
     names = [n for n in VARIANTS
              if n != "pallas_fused" or platform == "tpu"]
     rates: dict[str, tuple[float, float]] = {}
+    # global budget: a wedged-mid-bench tunnel must not burn a per-variant
+    # timeout SIX times — stop launching new variants past the budget and
+    # report what was measured
+    budget_s = int(os.environ.get("DEEPFM_BENCH_TOTAL_BUDGET", "1500"))
+    t_bench0 = time.time()
     for name in names:
+        if rates and time.time() - t_bench0 > budget_s:
+            print(f"bench budget ({budget_s}s) exhausted; skipping {name}",
+                  file=sys.stderr)
+            continue
         try:
             r = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--variant", name],
