@@ -1,0 +1,154 @@
+"""Online-scoring benchmark: latency/QPS over an exported servable.
+
+The reference's serving path is `export_savedmodel` -> TF Serving REST
+(ps:535-551, SURVEY §3.4); here the analog is `serve/export.py` ->
+`serve/server.py` speaking the same REST `:predict` shape.  This bench
+measures the two layers separately so network/json overhead is attributable:
+
+  scorer_*        direct in-process Scorer.score calls (the compiled apply
+                  fn + fixed-batch padding) at several client batch sizes
+  http_*          full loop through the HTTP endpoint with JSON bodies
+                  (single connection, sequential requests)
+
+Persists docs/BENCH_SERVING.json ({latest, runs}; TPU latest kept over
+fallback runs).
+
+Run:  JAX_PLATFORMS=axon python benchmarks/serving.py --persist
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import _bench_util as bu
+
+V, F = 117_581, 39
+
+
+def build_servable(tmp: str) -> str:
+    from deepfm_tpu.core.config import Config
+    from deepfm_tpu.serve import export_servable
+    from deepfm_tpu.train import create_train_state
+
+    cfg = Config.from_dict({
+        "model": {
+            "feature_size": V, "field_size": F, "embedding_size": 32,
+            "deep_layers": (128, 64, 32), "dropout_keep": (0.5, 0.5, 0.5),
+        },
+    })
+    state = create_train_state(cfg)
+    out = os.path.join(tmp, "servable")
+    export_servable(cfg, state, out)
+    return out
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--requests", type=int, default=200)
+    p.add_argument("--client-batches", default="1,64,1024")
+    p.add_argument("--persist", action="store_true")
+    args = p.parse_args()
+
+    from deepfm_tpu.core.platform import sanitize_backend
+
+    sanitize_backend()
+    platform, device_kind = bu.backend_platform()
+
+    from deepfm_tpu.serve.export import load_servable
+    from deepfm_tpu.serve.server import Scorer, make_handler
+
+    rows = []
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory() as tmp:
+        servable = build_servable(tmp)
+        predict, cfg = load_servable(servable)
+        scorer = Scorer(predict, cfg.model.field_size)
+
+        def batch(n):
+            return (rng.integers(0, V, (n, F)),
+                    rng.random((n, F), dtype=np.float32))
+
+        for cb in [int(x) for x in args.client_batches.split(",")]:
+            ids, vals = batch(cb)
+            scorer.score(ids, vals)  # warm (compile)
+            t0 = time.perf_counter()
+            for _ in range(args.requests):
+                scorer.score(ids, vals)
+            dt = time.perf_counter() - t0
+            rows.append({
+                "layer": "scorer", "client_batch": cb,
+                "p50_ms_est": round(1e3 * dt / args.requests, 3),
+                "rows_per_sec": round(args.requests * cb / dt, 1),
+            })
+            print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
+
+        # full HTTP round trip (TF Serving REST shape), single connection
+        import http.client
+        import threading
+        from http.server import ThreadingHTTPServer
+
+        srv = ThreadingHTTPServer(
+            ("127.0.0.1", 0), make_handler(scorer, "deepfm")
+        )
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        port = srv.server_address[1]
+        try:
+            for cb in [int(x) for x in args.client_batches.split(",")]:
+                ids, vals = batch(cb)
+                body = json.dumps({
+                    "instances": [
+                        {"feat_ids": ids[i].tolist(),
+                         "feat_vals": vals[i].tolist()}
+                        for i in range(cb)
+                    ]
+                })
+                conn = http.client.HTTPConnection("127.0.0.1", port)
+                n_req = max(10, args.requests // 4)
+                # warm
+                conn.request("POST", "/v1/models/deepfm:predict", body,
+                             {"Content-Type": "application/json"})
+                assert conn.getresponse().read()
+                t0 = time.perf_counter()
+                for _ in range(n_req):
+                    conn.request("POST", "/v1/models/deepfm:predict", body,
+                                 {"Content-Type": "application/json"})
+                    r = conn.getresponse()
+                    payload = r.read()
+                    assert r.status == 200, payload[:200]
+                dt = time.perf_counter() - t0
+                conn.close()
+                rows.append({
+                    "layer": "http", "client_batch": cb,
+                    "p50_ms_est": round(1e3 * dt / n_req, 3),
+                    "rows_per_sec": round(n_req * cb / dt, 1),
+                })
+                print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
+        finally:
+            srv.shutdown()
+
+    out = {"platform": platform, "device_kind": device_kind,
+           "model": {"V": V, "F": F},
+           "requests": args.requests,
+           "recorded_unix_time": int(time.time()), "rows": rows}
+    print(json.dumps(out))
+    if args.persist:
+        bu.persist_latest_runs(
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "docs", "BENCH_SERVING.json"),
+            out, ok=len(rows), platform=platform,
+        )
+
+
+if __name__ == "__main__":
+    main()

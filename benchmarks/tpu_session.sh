@@ -41,6 +41,10 @@ echo "== model-family step rates (xDeepFM / DCN-v2 / two-tower) =="
 JAX_PLATFORMS=axon timeout 5400 \
     python benchmarks/model_zoo.py --persist || status=1
 
+echo "== online-scoring latency/QPS over the exported servable =="
+JAX_PLATFORMS=axon timeout 1200 \
+    python benchmarks/serving.py --persist || status=1
+
 echo "== Criteo-Kaggle-scale convergence on device (45M records/epoch) =="
 JAX_PLATFORMS=axon timeout 2400 \
     python benchmarks/convergence_device.py --records-per-epoch 45000000 \

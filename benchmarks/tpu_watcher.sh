@@ -46,6 +46,7 @@ commit_with_retry() {
         docs/BENCH_INGEST.json docs/BENCH_LARGE_VOCAB.json \
         docs/BENCH_TRANSFER.json docs/BENCH_TPU_TUNE.json \
         docs/BENCH_MODEL_ZOO.json docs/BENCH_CONVERGENCE_DEVICE.json \
+        docs/BENCH_SERVING.json \
         docs/TPU_WATCHER_LOG.jsonl docs/TPU_SESSION_OUT.log; do
         [[ -e $p ]] && paths+=("$p")
     done
