@@ -124,6 +124,12 @@ def test_run_train_steps_per_loop_end_to_end(tmp_path):
     assert ckpt.latest_step() == 10
     ckpt.close()
 
+    # resume: rerunning with more epochs restores step 10 and continues in
+    # K-step dispatches (input-position skip counts optimizer steps, which
+    # equal consumed batches regardless of steps_per_loop)
+    state = run_train(cfg.with_overrides(data={"num_epochs": 4}))
+    assert int(state.step) == 20
+
 
 def test_metric_logger_multi_step_and_resume():
     """The logger must fire on crossed log_steps boundaries even when step
