@@ -18,7 +18,6 @@ hvd:402-415) — and spot-instance restart resumes from the latest checkpoint
 from __future__ import annotations
 
 import os
-from typing import Any
 
 import jax
 import orbax.checkpoint as ocp

@@ -33,7 +33,7 @@ import json
 import math
 import os
 from collections import Counter
-from typing import IO, Iterable, Iterator
+from typing import Iterable
 
 from .tfrecord import TFRecordWriter
 from .example_proto import serialize_ctr_example

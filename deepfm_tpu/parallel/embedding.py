@@ -24,7 +24,6 @@ spread hot rows across shards; the input pipeline applies it when
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
