@@ -34,12 +34,18 @@ def _host_batches(cfg, n, b=16, seed=0):
     return [_batch(k, b, cfg) for k in keys]
 
 
-@pytest.mark.parametrize("lazy", [False, True], ids=["dense", "lazy"])
-@pytest.mark.parametrize("dp,mp", [(2, 4), (8, 1)])
-def test_scan_loop_matches_sequential(dp, mp, lazy):
+@pytest.mark.parametrize(
+    "dp,mp,lazy,bn",
+    [(2, 4, False, False), (8, 1, False, False),
+     (2, 4, True, False), (8, 1, True, False),
+     (2, 4, False, True)],   # BN: moving stats thread through the scan carry
+    ids=["dense_2x4", "dense_8x1", "lazy_2x4", "lazy_8x1", "bn_2x4"],
+)
+def test_scan_loop_matches_sequential(dp, mp, lazy, bn):
     cfg = CFG.with_overrides(
         mesh={"data_parallel": dp, "model_parallel": mp},
         optimizer={"lazy_embedding_updates": lazy},
+        model={"batch_norm": bn},
     )
     mesh = _mesh(dp, mp)
     ctx = make_context(cfg, mesh)
@@ -61,6 +67,11 @@ def test_scan_loop_matches_sequential(dp, mp, lazy):
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6),
         jax.device_get(scan_state.params),
         jax.device_get(seq_state.params),
+    )
+    jax.tree_util.tree_map(  # BN moving stats thread through the scan carry
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6),
+        jax.device_get(scan_state.model_state),
+        jax.device_get(seq_state.model_state),
     )
     for i in range(K):
         for key in ("loss", "ce", "pred_mean"):
