@@ -147,6 +147,9 @@ def main() -> None:
     p.add_argument("--batch", type=int, default=16384)
     p.add_argument("--eval-batches", type=int, default=32)
     p.add_argument("--lazy", action="store_true")
+    p.add_argument("--seed", type=int, default=0,
+                   help="student init + data-stream seed (teacher stays "
+                        "seed-0 so every run shares the same planted task)")
     p.add_argument("--persist", action="store_true")
     args = p.parse_args()
 
@@ -175,11 +178,15 @@ def main() -> None:
                       "lazy_embedding_updates": bool(args.lazy)},
         "data": {"batch_size": args.batch},
     })
-    state = create_train_state(cfg)
+    import jax.random as jrandom
+
+    state = create_train_state(
+        cfg, key=jrandom.PRNGKey(1000 + args.seed)
+    )
     train_step = make_train_step(cfg)
 
     steps_per_epoch = max(1, args.records_per_epoch // args.batch)
-    data_key = jax.random.PRNGKey(7)
+    data_key = jax.random.PRNGKey(7 + args.seed)
     eval_key = jax.random.PRNGKey(1009)     # disjoint from training keys
 
     @jax.jit
@@ -257,6 +264,7 @@ def main() -> None:
         "batch": args.batch,
         "steps_per_epoch": steps_per_epoch,
         "variant": "lazy_adam" if args.lazy else "dense_xla",
+        "seed": args.seed,
         "teacher_bias": round(float(bias), 4),
         "setup_secs": round(setup_s, 2),
         "eval_records": args.eval_batches * args.batch,
