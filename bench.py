@@ -47,11 +47,20 @@ HBM_GBPS = {"tpu": 819.0}  # v5e HBM bandwidth; absent => no roofline claim
 
 
 def _probe_tpu(timeout_s: int) -> bool:
-    """Try the tunneled-TPU attach in a subprocess with a hard watchdog."""
+    """Probe the tunneled TPU in a subprocess with a hard watchdog.
+
+    Readiness = attach AND a tiny compile+execute round trip: the attach
+    can succeed while the remote compile service is wedged (observed in
+    round 3), and a bench launched into that state burns every variant's
+    timeout for nothing."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "axon"
     env.pop("DEEPFM_BENCH_FALLBACK", None)
-    code = "import jax; d=jax.devices(); print('OK', d[0].platform)"
+    code = (
+        "import jax, jax.numpy as jnp; "
+        "f = jax.jit(lambda x: (x @ x).sum()); "
+        "f(jnp.ones((128, 128))).block_until_ready(); print('OK')"
+    )
     try:
         r = subprocess.run(
             [sys.executable, "-c", code],
