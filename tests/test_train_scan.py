@@ -173,3 +173,51 @@ def test_metric_logger_multi_step_and_resume():
     assert rec["step_ms"] * 12 == pytest.approx(
         rec["step_ms"] * (5012 - 5000), rel=1e-6
     )
+
+
+def test_run_train_steps_per_loop_stream_mode(tmp_path):
+    """Pipe-mode + steps_per_loop: a FIFO channel that closes mid-chunk
+    drains through the single-step tail — every record trains, none twice."""
+    import os
+    import threading
+
+    from deepfm_tpu.data.example_proto import serialize_ctr_example
+    from deepfm_tpu.data.tfrecord import frame_record
+    from deepfm_tpu.train.loop import run_train
+
+    fifo = tmp_path / "training"
+    os.mkfifo(fifo)
+    rng = np.random.default_rng(0)
+    n_records = 16 * 5  # 5 batches of 16 -> 2 stacked dispatches + 1 tail
+    payload = b"".join(
+        frame_record(serialize_ctr_example(
+            float(rng.random() < 0.3),
+            rng.integers(0, 117, 6).tolist(),
+            rng.random(6).astype(np.float32).tolist(),
+        ))
+        for _ in range(n_records)
+    )
+
+    def feeder():
+        with open(fifo, "wb") as f:
+            f.write(payload)
+
+    t = threading.Thread(target=feeder, daemon=True)
+    t.start()
+    cfg = CFG.with_overrides(
+        mesh={"data_parallel": 8, "model_parallel": 1},
+        data={
+            "training_data_dir": str(tmp_path),
+            "batch_size": 16,
+            "num_epochs": 1,
+            "stream_mode": True,
+        },
+        run={
+            "model_dir": str(tmp_path / "model"),
+            "servable_model_dir": "",
+            "steps_per_loop": 2,
+        },
+    )
+    state = run_train(cfg)
+    t.join(timeout=10)
+    assert int(state.step) == 5  # 4 scanned sub-steps + 1 tail step
