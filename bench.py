@@ -15,7 +15,9 @@ this model's dense-Adam step at V=117k moves ~90 MB of optimizer/param state
 per step, so the floor on a v5e (819 GB/s) is ~110 µs/step.
 
 TPU attach: the tunneled backend ("axon") can hang for many minutes when the
-tunnel is down, so the attach is probed in a SUBPROCESS with a watchdog
+tunnel is down, so readiness (attach + a tiny compile+execute round trip —
+the attach alone can succeed while the compile service is wedged) is probed
+in a SUBPROCESS with a watchdog
 (DEEPFM_TPU_ATTACH_TIMEOUT, default 420 s) and falls back to CPU on timeout.
 Every successful TPU measurement is persisted to ``BENCH_TPU.json`` so the
 number survives later tunnel outages (judge round-1 finding #1).
@@ -43,7 +45,14 @@ import numpy as np
 NORTH_STAR_PER_CHIP = 1_000_000 / 64  # examples/sec/chip
 V, F, K = 117_581, 39, 32
 DEEP = (128, 64, 32)
-HBM_GBPS = {"tpu": 819.0}  # v5e HBM bandwidth; absent => no roofline claim
+# HBM bandwidth by device_kind (GB/s); unknown kind => no roofline claim
+HBM_GBPS = {
+    "TPU v5 lite": 819.0,   # v5e (the tunneled chip reports this kind)
+    "TPU v5e": 819.0,
+    "TPU v4": 1228.0,
+    "TPU v5p": 2765.0,
+    "TPU v6e": 1640.0,
+}
 
 
 def _probe_tpu(timeout_s: int) -> bool:
@@ -99,7 +108,7 @@ def resolve_platform() -> None:
         os.environ["JAX_PLATFORMS"] = "cpu"
 
 
-def dense_adam_roofline(platform: str) -> dict:
+def dense_adam_roofline(platform: str, device_kind: str = "") -> dict:
     """HBM-traffic floor for the dense-Adam step: params+m+v read & write
     for the two embedding tables (the MLP is negligible), plus the batch
     gathers.  This is the honest per-chip perf frame (the model is
@@ -107,7 +116,7 @@ def dense_adam_roofline(platform: str) -> dict:
     when the measured platform's memory bandwidth is unknown (e.g. the CPU
     fallback) the time floor is marked unavailable but the traffic estimate
     still frames the result."""
-    bw = HBM_GBPS.get(platform)
+    bw = HBM_GBPS.get(device_kind) if platform == "tpu" else None
     table_bytes = (V * K + V) * 4          # fm_v + fm_w, f32
     mlp = F * K * DEEP[0] + DEEP[0] * DEEP[1] + DEEP[1] * DEEP[2] + DEEP[2]
     state_traffic = (table_bytes + mlp * 4) * 3 * 2   # p,m,v x read+write
@@ -121,8 +130,8 @@ def dense_adam_roofline(platform: str) -> dict:
         roof["hbm_bw_gbps"] = None
         roof["roofline_step_us"] = None
         roof["note"] = (
-            f"memory bandwidth unknown for platform={platform!r}; "
-            "time floor unavailable (bandwidth table covers tpu only)"
+            f"memory bandwidth unknown for platform={platform!r} "
+            f"device_kind={device_kind!r}; time floor unavailable"
         )
     else:
         roof["hbm_bw_gbps"] = bw
@@ -246,6 +255,22 @@ VARIANTS = {
 }
 
 
+def _device_kind(platform: str) -> str:
+    """Fetch device_kind via a bounded subprocess (the parent never holds a
+    client on the tunneled attach); best-effort — '' on any failure."""
+    if platform != "tpu":
+        return ""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].device_kind)"],
+            capture_output=True, text=True, timeout=120,
+        )
+        return r.stdout.strip() if r.returncode == 0 else ""
+    except Exception:
+        return ""
+
+
 def run_variant(name: str) -> None:
     """Child mode (--variant NAME): measure one variant in THIS process and
     print its JSON row.  Variants are isolated in subprocesses because
@@ -333,7 +358,7 @@ def main() -> None:
         "variant": best,
         "variants": {k: round(v[0], 1) for k, v in rates.items()},
     }
-    roof = dense_adam_roofline(platform)
+    roof = dense_adam_roofline(platform, _device_kind(platform))
     xla_rate = rates.get("xla", (0.0, 0.0))[0]
     if xla_rate:
         meas_us = 1e6 * batch_size / xla_rate
