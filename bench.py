@@ -232,14 +232,19 @@ def measure_spmd(lazy: bool, steps_per_loop: int = 1) -> tuple[float, float]:
     mesh = build_mesh(MeshConfig(data_parallel=1, model_parallel=1))
     ctx = make_context(c, mesh)
     state = create_spmd_state(ctx)
-    host = _synth_batches(BATCH, device_put=False)
     if steps_per_loop > 1:
+        # 8 DISTINCT stacked batches (8*k host batches), matching the 8
+        # distinct inputs the single-step variants cycle — one stacked batch
+        # would replay identical data every dispatch (round-3 advisor #2)
         k = steps_per_loop
+        host = _synth_batches(BATCH, nb=8 * k, device_put=False)
         step_fn = make_spmd_train_loop(ctx, k)
-        sb = [shard_batch_stacked(ctx, host[i:i + k], validate_ids=False)
-              for i in range(0, len(host), k)]
+        sb = [shard_batch_stacked(ctx, host[i * k:(i + 1) * k],
+                                  validate_ids=False)
+              for i in range(8)]
         rate, loss = _time_loop(step_fn, state, sb)
         return rate, loss
+    host = _synth_batches(BATCH, device_put=False)
     step_fn = make_spmd_train_step(ctx)  # donated, jitted inside
     sb = [shard_batch(ctx, hb, validate_ids=False) for hb in host]
     return _time_loop(step_fn, state, sb)

@@ -122,7 +122,18 @@ def persist_latest_runs(path: str, out: dict, *, ok: int,
                 latest = prev_latest
                 print(f"keeping previous latest ({path}): ok={ok} "
                       f"platform={platform}", file=sys.stderr)
-        except Exception:
+        except Exception as e:
+            # an unreadable artifact must not be silently truncated (that
+            # would also skip the never-demote-TPU-latest guard): preserve
+            # the bytes for forensics and start a fresh history
+            backup = path + ".corrupt"
+            try:
+                os.replace(path, backup)
+            except OSError:
+                backup = "<unmovable>"
+            print(f"WARNING: {path} unreadable ({type(e).__name__}: {e}); "
+                  f"backed up to {backup}, starting fresh history",
+                  file=sys.stderr)
             runs = []
     with open(path, "w") as f:
         json.dump({"latest": latest, "runs": runs + [out]}, f, indent=1)

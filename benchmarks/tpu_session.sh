@@ -3,38 +3,49 @@
 # reachable (the tunnel watcher invokes this; it is safe to re-run: every
 # persist path keeps {latest, runs} history and never demotes TPU data).
 #
-# PHASE ORDER = VALUE ORDER for a possibly-short window: artifacts with no
-# TPU row yet run first; refreshes of already-committed TPU evidence run
-# last.  The round-3 morning window lasted ~74 min; this session is ~110
-# min if everything runs.
+# PHASE ORDER = VALUE ORDER for a possibly-short window (round-3 window was
+# ~74 min; rounds 1-2 had none).  Round-4 priorities (VERDICT r03):
+#   #1 the PRODUCT path: spmd/scanK sweep + dispatch decomposition
+#   #3 TPU rows for convergence-device and serving (latest still cpu)
+#   #4 Pallas in its own regime (V=10M, table HBM-resident)
+# Refreshes of already-committed TPU evidence run last.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 export DEEPFM_TPU_ATTACH_TIMEOUT="${DEEPFM_TPU_ATTACH_TIMEOUT:-300}"
 status=0
 
-echo "== host<->device transfer bandwidth (frames every e2e number) =="
+echo "== host<->device transfer + dispatch latency (frames every e2e number) =="
 JAX_PLATFORMS=axon timeout 900 \
     python benchmarks/transfer.py --persist || status=1
 
+echo "== PRODUCT-path sweep: jit vs spmd vs spmd_scanK (verdict r03 #1) =="
+JAX_PLATFORMS=axon timeout 3600 \
+    python benchmarks/spmd_sweep.py --persist || status=1
+
 echo "== single-chip bench (BENCH_TPU.json; per-variant subprocess isolation) =="
 JAX_PLATFORMS=axon timeout 2400 python bench.py || status=1
-
-echo "== batch-size x variant tuning sweep (per-point process isolation) =="
-JAX_PLATFORMS=axon timeout 3600 \
-    python benchmarks/tpu_tune.py --persist || status=1
-
-echo "== model-family step rates (xDeepFM / DCN-v2 / two-tower) =="
-JAX_PLATFORMS=axon timeout 3600 \
-    python benchmarks/model_zoo.py --persist || status=1
-
-echo "== online-scoring latency/QPS over the exported servable =="
-JAX_PLATFORMS=axon timeout 1200 \
-    python benchmarks/serving.py --persist || status=1
 
 echo "== Criteo-Kaggle-scale convergence on device (45M records/epoch) =="
 JAX_PLATFORMS=axon timeout 2400 \
     python benchmarks/convergence_device.py --records-per-epoch 45000000 \
     --epochs 4 --batch 16384 --persist || status=1
+
+echo "== online-scoring latency/QPS over the exported servable =="
+JAX_PLATFORMS=axon timeout 1200 \
+    python benchmarks/serving.py --persist || status=1
+
+echo "== Pallas in its own regime: V=10M HBM-resident table (verdict r03 #4) =="
+JAX_PLATFORMS=axon timeout 1800 \
+    python benchmarks/tpu_tune.py --vocab 10000000 --batches 8192,65536 \
+    --out BENCH_PALLAS_10M.json --persist || status=1
+
+echo "== model-family step rates (xDeepFM / DCN-v2 / two-tower) =="
+JAX_PLATFORMS=axon timeout 3600 \
+    python benchmarks/model_zoo.py --persist || status=1
+
+echo "== batch-size x variant tuning sweep (per-point process isolation) =="
+JAX_PLATFORMS=axon timeout 3600 \
+    python benchmarks/tpu_tune.py --persist || status=1
 
 echo "== pallas compiled correctness (DEEPFM_TEST_TPU=1 -> interpret off) =="
 JAX_PLATFORMS=axon DEEPFM_TEST_TPU=1 timeout 1800 \

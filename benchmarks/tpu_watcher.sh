@@ -46,7 +46,8 @@ commit_with_retry() {
         docs/BENCH_INGEST.json docs/BENCH_LARGE_VOCAB.json \
         docs/BENCH_TRANSFER.json docs/BENCH_TPU_TUNE.json \
         docs/BENCH_MODEL_ZOO.json docs/BENCH_CONVERGENCE_DEVICE.json \
-        docs/BENCH_SERVING.json \
+        docs/BENCH_SERVING.json docs/BENCH_SPMD_SWEEP.json \
+        docs/BENCH_PALLAS_10M.json \
         docs/TPU_WATCHER_LOG.jsonl docs/TPU_SESSION_OUT.log; do
         [[ -e $p ]] && paths+=("$p")
     done

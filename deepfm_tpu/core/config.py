@@ -177,7 +177,10 @@ class RunConfig:
     # 135 us on-chip step.  1 = step-per-dispatch (reference-equivalent
     # cadence).  Checkpoint/eval/logging granularity becomes K steps.
     # Applies to the CTR train task (train/loop.run_train); the retrieval
-    # family keeps step-per-dispatch.
+    # family keeps step-per-dispatch.  On a live FIFO (pipe-mode) feed, K
+    # host batches buffer before each dispatch, so a slow producer adds up
+    # to K-1 batches of latency and a partial tail chunk only drains at
+    # stream close — prefer 1 for latency-sensitive streaming.
     steps_per_loop: int = 1
     eval_start_delay_secs: int = 0    # reference: 1000 (ps:517); 0 = eval immediately
     eval_throttle_secs: int = 0       # reference: 1200 (ps:519)

@@ -31,6 +31,43 @@ T = TypeVar("T")
 
 _DEFAULT_SIGNALS = (signal.SIGTERM, signal.SIGINT)
 
+# Signals delivered BEFORE a PreemptionGuard exists (during the CLI's heavy
+# imports and config resolution — seconds of exposure on a loaded host) land
+# here; the guard folds the flag into should_stop on __enter__.  The only
+# uncovered window left is interpreter/package import itself, where no state
+# exists to lose and default die-and-reschedule semantics are correct.
+_EARLY_SIGNAL = threading.Event()
+
+
+def install_early_handler(signals=_DEFAULT_SIGNALS) -> bool:
+    """Install a minimal record-only handler for the pre-guard window.
+
+    Called by the launcher at task entry (train tasks only — serve/eval/
+    infer keep default signal semantics so SIGTERM still stops them).
+    A REPEATED signal escalates to default handling (immediate termination)
+    so a wedged setup can still be killed with a second Ctrl-C.
+    No-op off the main thread.  Returns True when installed."""
+    if threading.current_thread() is not threading.main_thread():
+        return False
+
+    def _record(signum, frame) -> None:
+        if _EARLY_SIGNAL.is_set():
+            _escalate(signum)
+        _EARLY_SIGNAL.set()
+
+    for sig in signals:
+        signal.signal(sig, _record)
+    return True
+
+
+def _escalate(signum) -> None:
+    """Second termination signal: stop being graceful — restore the default
+    handler and re-deliver, terminating immediately."""
+    import os
+
+    signal.signal(signum, signal.SIG_DFL)
+    os.kill(os.getpid(), signum)
+
 
 class PreemptionGuard:
     """Cooperative stop flag set by termination signals.
@@ -54,6 +91,13 @@ class PreemptionGuard:
             for sig in self._signals:
                 self._prev[sig] = signal.signal(sig, self._handle)
             self._installed = True
+        if _EARLY_SIGNAL.is_set():
+            # a termination signal landed in the pre-guard window
+            # (install_early_handler): honor it as an immediate stop request.
+            # Consume the flag — THIS guard acts on it; a fresh guard in the
+            # same process (retry harness, notebook re-run) starts clean
+            _EARLY_SIGNAL.clear()
+            self.request_stop()
         return self
 
     def __exit__(self, *exc) -> None:
@@ -66,6 +110,11 @@ class PreemptionGuard:
     # -- flag --------------------------------------------------------------
 
     def _handle(self, signum, frame) -> None:
+        if self._stop.is_set():
+            # repeated signal while a graceful stop is already pending
+            # (e.g. Ctrl-C during a long compile): escalate to default
+            # handling so the process can actually be terminated
+            _escalate(signum)
         self.signaled_at = time.time()
         self._stop.set()
 

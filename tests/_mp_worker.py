@@ -14,10 +14,16 @@ import sys
 
 def main() -> None:
     port, rank, workdir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    # world size is parameterized (MP_TEST_NPROC): 2 procs x 4 devices or
+    # 4 procs x 2 devices — either way one 8-device [4,2] global mesh, so
+    # the 4-process case exercises params whose model-axis shards span
+    # process boundaries (each process holds HALF of each table shard pair)
+    nproc = int(os.environ.get("MP_TEST_NPROC", "2"))
+    local_devices = 8 // nproc
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=4"
+        + f" --xla_force_host_platform_device_count={local_devices}"
     ).strip()
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     sys.path.insert(0, repo)
@@ -43,7 +49,7 @@ def main() -> None:
             },
             "mesh": {
                 "coordinator_address": f"localhost:{port}",
-                "num_processes": 2,
+                "num_processes": nproc,
                 "process_id": rank,
                 "data_parallel": 4,
                 "model_parallel": 2,
@@ -63,15 +69,15 @@ def main() -> None:
     import jax
     import numpy as np
 
-    assert jax.process_count() == 2, jax.process_count()
-    assert len(jax.local_devices()) == 4
+    assert jax.process_count() == nproc, jax.process_count()
+    assert len(jax.local_devices()) == local_devices
     assert jax.device_count() == 8
     mesh = build_mesh(cfg.mesh)
     ctx = make_context(cfg, mesh)
     state = create_spmd_state(ctx)
     step_fn = make_spmd_train_step(ctx, donate=False)
 
-    GB, P = 32, 2  # global batch, process count
+    GB, P = 32, nproc  # global batch, process count
     rng = np.random.default_rng(0)  # same seed everywhere: one global stream
     losses = []
     for _ in range(4):

@@ -22,7 +22,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_pair(tmp_path, *, lazy: bool) -> list[dict]:
+def _run_workers(tmp_path, *, lazy: bool, nproc: int = 2,
+                 timeout: int = 420) -> list[dict]:
     port = _free_port()
     env = {
         k: v
@@ -30,17 +31,18 @@ def _run_pair(tmp_path, *, lazy: bool) -> list[dict]:
         if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
     }
     env["MP_TEST_LAZY"] = "1" if lazy else "0"
+    env["MP_TEST_NPROC"] = str(nproc)
     procs = [
         subprocess.Popen(
             [sys.executable, WORKER, str(port), str(r), str(tmp_path)],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         )
-        for r in range(2)
+        for r in range(nproc)
     ]
     outs = []
     for p in procs:
         try:
-            out, err = p.communicate(timeout=420)
+            out, err = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -57,7 +59,7 @@ def _run_pair(tmp_path, *, lazy: bool) -> list[dict]:
 
 @pytest.mark.parametrize("lazy", [False, True])
 def test_two_process_train_ckpt_export(tmp_path, lazy):
-    results = _run_pair(tmp_path, lazy=lazy)
+    results = _run_workers(tmp_path, lazy=lazy)
     by_rank = {r["rank"]: r for r in results}
     assert set(by_rank) == {0, 1}
     # pmean'd loss is replicated: both processes must report identical values
@@ -87,6 +89,35 @@ def test_two_process_train_ckpt_export(tmp_path, lazy):
     )
     assert prob.shape == (8,)
     assert np.all((prob >= 0) & (prob <= 1))
+
+
+def test_four_process_train_ckpt_export(tmp_path):
+    """Same lifecycle at 4 processes x 2 local devices (round-3 verdict #6):
+    the global [4,2] mesh now splits each model-axis table shard ACROSS two
+    processes, so collective checkpoint save/restore and the fused scan loop
+    run with non-process-local shard boundaries."""
+    results = _run_workers(tmp_path, lazy=False, nproc=4, timeout=600)
+    by_rank = {r["rank"]: r for r in results}
+    assert set(by_rank) == {0, 1, 2, 3}
+    for r in range(1, 4):
+        np.testing.assert_allclose(
+            by_rank[0]["losses"], by_rank[r]["losses"], rtol=1e-6
+        )
+    assert by_rank[0]["restored_step"] == 4
+    assert by_rank[0]["losses"][-1] < by_rank[0]["losses"][0]
+    servable = tmp_path / "servable"
+    assert (servable / "config.json").exists()
+    from deepfm_tpu.serve import load_servable
+
+    predict, cfg = load_servable(servable)
+    rng = np.random.default_rng(1)
+    prob = np.asarray(
+        predict(
+            rng.integers(0, 117, size=(8, 6)),
+            rng.random((8, 6)).astype(np.float32),
+        )
+    )
+    assert prob.shape == (8,) and np.all((prob >= 0) & (prob <= 1))
 
 
 CLI_WORKER = os.path.join(os.path.dirname(__file__), "_mp_cli_worker.py")
