@@ -92,6 +92,19 @@ class OptimizerConfig:
     learning_rate: float = 0.0005     # ps:56
     # Horovod path scales lr by world size (hvd:171). Explicit knob here.
     scale_lr_by_data_parallel: bool = False
+    # Beyond-reference (the reference is constant-lr only, ps:292-305):
+    # warmup + decay schedules over OPTIMIZER steps.  constant|cosine|linear;
+    # cosine/linear need decay_steps (TOTAL horizon incl. warmup) and end at
+    # learning_rate * lr_end_fraction.  Resume-safe: the schedule reads the
+    # restored step count.
+    lr_schedule: str = "constant"
+    warmup_steps: int = 0
+    decay_steps: int = 0
+    lr_end_fraction: float = 0.0
+    # lr split: fm_w/fm_v (the tables the reference's PS hosted) train at
+    # learning_rate * this; the MLP/bias keep the base lr.  Exact lr-split
+    # semantics for Adam/Adagrad/Momentum; rejected for Ftrl.
+    embedding_lr_multiplier: float = 1.0
     # touched-rows-only Adam for the embedding tables (train/lazy.py): the
     # TF1 sparse_apply_adam capability; Adam-only, single-controller path
     lazy_embedding_updates: bool = False

@@ -356,9 +356,15 @@ def _build_lazy_local_step(ctx: SPMDContext, model, tx) -> Callable:
 
     cfg = ctx.cfg
     true_vocab = ctx.true_feature_size
-    lr = cfg.optimizer.learning_rate
-    if cfg.optimizer.scale_lr_by_data_parallel:
-        lr = lr * cfg.mesh.data_parallel
+    from ..train.optimizer import build_lr_schedule, schedule_value
+
+    # constant or step->lr schedule; evaluated at state.step inside the
+    # traced step so warmup/decay and the embedding lr split apply to the
+    # lazy tables exactly as the dense path applies them via optax
+    lr_sched = build_lr_schedule(
+        cfg.optimizer, data_parallel_size=cfg.mesh.data_parallel
+    )
+    emb_mult = cfg.optimizer.embedding_lr_multiplier
     from ..parallel.embedding import sharded_lookup
 
     def local_step(state: TrainState, batch: dict):
@@ -422,6 +428,7 @@ def _build_lazy_local_step(ctx: SPMDContext, model, tx) -> Callable:
         )
         order, seg, row_id, valid = shared_segments(flat_ids)
         step1 = state.step + 1
+        lr = schedule_value(lr_sched, state.step) * emb_mult
         new_tables, new_m, new_v = {}, {}, {}
         for k in keys:
             g = lax.all_gather(

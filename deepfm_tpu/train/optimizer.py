@@ -65,23 +65,126 @@ def ftrl(
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+def build_lr_schedule(cfg: OptimizerConfig, *, data_parallel_size: int = 1):
+    """Resolve the learning-rate schedule: a float for the reference's
+    constant-lr behavior (ps:292-305 — the reference has no schedules), or
+    an ``optax`` schedule (step -> lr) when warmup/decay is configured.
+
+    The step count a schedule sees is the OPTIMIZER step (optax's update
+    count for the dense path, ``state.step`` for the lazy path — the two
+    advance in lockstep), so checkpoint resume continues the schedule at
+    the right point.
+    """
+    peak = cfg.learning_rate
+    if cfg.scale_lr_by_data_parallel:
+        peak = peak * data_parallel_size  # hvd:171 semantics, now explicit
+    name = cfg.lr_schedule.lower()
+    warmup = cfg.warmup_steps
+    if name == "constant":
+        if warmup <= 0:
+            return peak
+        return optax.schedules.join_schedules(
+            [optax.schedules.linear_schedule(0.0, peak, warmup),
+             optax.schedules.constant_schedule(peak)],
+            [warmup],
+        )
+    if cfg.decay_steps <= warmup:
+        raise ValueError(
+            f"lr_schedule={name!r} needs decay_steps > warmup_steps "
+            f"(got {cfg.decay_steps} <= {warmup}); decay_steps is the TOTAL "
+            f"schedule horizon including warmup"
+        )
+    end = peak * cfg.lr_end_fraction
+    if name == "cosine":
+        return optax.schedules.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=peak, warmup_steps=warmup,
+            decay_steps=cfg.decay_steps, end_value=end,
+        )
+    if name == "linear":
+        return optax.schedules.join_schedules(
+            [optax.schedules.linear_schedule(0.0, peak, warmup),
+             optax.schedules.linear_schedule(
+                 peak, end, cfg.decay_steps - warmup)],
+            [warmup],
+        )
+    raise ValueError(
+        f"unknown lr_schedule {cfg.lr_schedule!r} (constant|cosine|linear)"
+    )
+
+
+def schedule_value(lr_sched, step):
+    """Evaluate a ``build_lr_schedule`` result at an optimizer step: floats
+    (and config-supplied ints) pass through, schedules are called.  The one
+    place the constant-vs-schedule type dispatch lives — both lazy paths
+    (train/step.py, parallel/spmd.py) use it inside their traced steps."""
+    return lr_sched(step) if callable(lr_sched) else lr_sched
+
+
+# params whose updates the embedding_lr_multiplier scales: the CTR tables
+# the reference's parameter servers hosted (FM_W [V], FM_V [V,K] —
+# ps:188-198) plus the two-tower retrieval tables.  Everything else
+# (MLP/towers, bias) keeps the base lr.
+EMBEDDING_PARAM_KEYS = ("fm_w", "fm_v", "user_embedding", "item_embedding")
+
+
+def _scale_embedding_updates(multiplier: float) -> optax.GradientTransformation:
+    """Post-scale fm_w/fm_v updates by ``multiplier`` — an exact per-group
+    lr split for optimizers whose update is linear in lr (Adam/Adagrad/
+    Momentum).  Stateless, so it does not change checkpoint structure
+    beyond the chain wrapper itself."""
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        del params
+
+        def scale(path, u):
+            leaf = path[-1]
+            name = getattr(leaf, "key", None) or str(leaf)
+            return u * multiplier if name in EMBEDDING_PARAM_KEYS else u
+
+        return jax.tree_util.tree_map_with_path(scale, updates), state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def build_optimizer(
     cfg: OptimizerConfig, *, data_parallel_size: int = 1
 ) -> optax.GradientTransformation:
-    lr = cfg.learning_rate
-    if cfg.scale_lr_by_data_parallel:
-        lr = lr * data_parallel_size  # hvd:171 semantics, now explicit
+    lr = build_lr_schedule(cfg, data_parallel_size=data_parallel_size)
     name = cfg.name.lower()
     if name == "adam":
-        return optax.adam(lr, b1=cfg.adam_b1, b2=cfg.adam_b2, eps=cfg.adam_eps)
-    if name == "adagrad":
+        tx = optax.adam(lr, b1=cfg.adam_b1, b2=cfg.adam_b2, eps=cfg.adam_eps)
+    elif name == "adagrad":
         # TF Adagrad has no epsilon term; the initial accumulator provides
         # numeric floor (ps:296-298)
-        return optax.adagrad(
+        tx = optax.adagrad(
             lr, initial_accumulator_value=cfg.adagrad_init_accum, eps=0.0
         )
-    if name == "momentum":
-        return optax.sgd(lr, momentum=cfg.momentum, nesterov=False)
-    if name == "ftrl":
-        return ftrl(lr)
-    raise ValueError(f"unknown optimizer {cfg.name!r} (Adam|Adagrad|Momentum|Ftrl)")
+    elif name == "momentum":
+        tx = optax.sgd(lr, momentum=cfg.momentum, nesterov=False)
+    elif name == "ftrl":
+        if callable(lr):
+            raise ValueError(
+                "Ftrl supports constant lr only (its z-state accumulates "
+                "1/lr-weighted terms; a schedule would change past state)"
+            )
+        if cfg.embedding_lr_multiplier != 1.0:
+            raise ValueError(
+                "embedding_lr_multiplier: Ftrl updates are full weight "
+                "rewrites, not lr-linear steps — the multiplier would not "
+                "be an lr split; use Adam/Adagrad/Momentum"
+            )
+        tx = ftrl(lr)
+    else:
+        raise ValueError(
+            f"unknown optimizer {cfg.name!r} (Adam|Adagrad|Momentum|Ftrl)"
+        )
+    if cfg.embedding_lr_multiplier != 1.0:
+        # chained only when active, so the default config keeps the bare
+        # optimizer's opt_state structure (checkpoint compatibility)
+        tx = optax.chain(tx, _scale_embedding_updates(
+            cfg.embedding_lr_multiplier))
+    return tx

@@ -181,11 +181,16 @@ def _make_lazy_train_step(cfg: Config, model, tx) -> Callable:
     from ..ops.embedding import dense_lookup
     from .lazy import LazyAdamState, lazy_adam_update, shared_segments
 
-    lr = cfg.optimizer.learning_rate
-    if cfg.optimizer.scale_lr_by_data_parallel:
-        lr = lr * _dp_size(cfg)
+    from .optimizer import build_lr_schedule, schedule_value
+
+    # constant or step->lr schedule, evaluated at state.step inside the
+    # traced step; the embedding lr split applies to the lazy tables
+    # (the dense `rest` params get it via optax in build_optimizer)
+    lr_sched = build_lr_schedule(cfg.optimizer, data_parallel_size=_dp_size(cfg))
+    emb_mult = cfg.optimizer.embedding_lr_multiplier
 
     def train_step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
+        lr = schedule_value(lr_sched, state.step) * emb_mult
         step_rng = jax.random.fold_in(state.rng, state.step)
         params = state.params
         keys = _lazy_keys(params)
