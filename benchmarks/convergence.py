@@ -297,17 +297,22 @@ def run_spmd(train_ds, eval_ds, *, epochs, batch_size, dp, mp, eval_every):
 def run_matched_steps(
     train_ds, eval_ds, *, variant: str, batch_size: int, seed: int,
     eval_every_steps: int, train_probe_rows: int = 200_000,
+    opt_overrides: dict | None = None, epochs: int = 1,
 ):
-    """One epoch over ``train_ds`` at matched step count for every variant
-    (dense / lazy / dp8 / dp4_mp2), identical batch order (shuffle seed 1),
-    differing only in init seed and execution path.  Evals at fixed step
-    milestones measure eval AUC/CE AND train-probe AUC (a fixed train
-    subsample — the no-overfit evidence)."""
+    """``epochs`` passes over ``train_ds`` at matched step count for every
+    variant (dense / lazy / dp8 / dp4_mp2), identical batch order (shuffle
+    seed = epoch number), differing only in init seed and execution path.
+    Evals at fixed step milestones measure eval AUC/CE AND train-probe AUC
+    (a fixed train subsample — the no-overfit evidence).  ``opt_overrides``
+    lets the schedule/lr-split study (verdict r03 #7) vary the optimizer
+    while keeping everything else matched."""
     lazy = variant == "lazy"
     spmd = variant.startswith("dp")
     cfg = flagship_cfg(batch_size, lazy=lazy).with_overrides(
         run={"seed": seed}
     )
+    if opt_overrides:
+        cfg = cfg.with_overrides(optimizer=opt_overrides)
     if spmd:
         from deepfm_tpu.core.config import MeshConfig
         from deepfm_tpu.parallel import (
@@ -370,25 +375,27 @@ def run_matched_steps(
     t0 = time.time()
     step = 0
     m = None
-    for batch in train_ds.batches(
-        batch_size, shuffle=True, seed=1, drop_remainder=True
-    ):
-        m = do_step(batch)
-        step += 1
-        if step % eval_every_steps == 0:
-            ev = evaluate(predict, eval_ds)
-            tr = evaluate(predict, probe)
-            curve.append({
-                "step": step,
-                "train_ce": round(float(m["ce"]), 5),
-                "eval_auc": round(ev["auc_streaming"], 5),
-                "eval_auc_exact": round(ev["auc_exact"], 5),
-                "eval_ce": round(ev["ce"], 5),
-                "train_probe_auc": round(tr["auc_streaming"], 5),
-                "train_probe_ce": round(tr["ce"], 5),
-            })
-            print(json.dumps({"variant": variant, "seed": seed, **curve[-1]}),
-                  file=sys.stderr)
+    for epoch in range(1, epochs + 1):
+        for batch in train_ds.batches(
+            batch_size, shuffle=True, seed=epoch, drop_remainder=True
+        ):
+            m = do_step(batch)
+            step += 1
+            if step % eval_every_steps == 0:
+                ev = evaluate(predict, eval_ds)
+                tr = evaluate(predict, probe)
+                curve.append({
+                    "step": step,
+                    "train_ce": round(float(m["ce"]), 5),
+                    "eval_auc": round(ev["auc_streaming"], 5),
+                    "eval_auc_exact": round(ev["auc_exact"], 5),
+                    "eval_ce": round(ev["ce"], 5),
+                    "train_probe_auc": round(tr["auc_streaming"], 5),
+                    "train_probe_ce": round(tr["ce"], 5),
+                })
+                print(json.dumps(
+                    {"variant": variant, "seed": seed, **curve[-1]}),
+                    file=sys.stderr)
     if not curve or curve[-1]["step"] != step:
         ev = evaluate(predict, eval_ds)
         tr = evaluate(predict, probe)
@@ -410,7 +417,10 @@ def run_synthetic(args) -> None:
     """VERDICT r02 #2: convergence evidence that can't be dismissed as
     overfit noise — >=5M Criteo-shaped records with planted teacher-FM
     structure, all four variants at matched steps, multi-seed error bars on
-    the dense path."""
+    the dense path.  With ``--tuned`` (a JSON optimizer-override dict from
+    the --opt-sweep study), also runs dense_tuned (multi-seed) and
+    lazy_tuned rows — the schedule/lr-split attack on the Bayes-ceiling gap
+    (verdict r03 #7)."""
     t0 = time.time()
     train_ds, eval_ds, gen_meta = make_synthetic(args.records, seed=7)
     meta = {
@@ -423,6 +433,15 @@ def run_synthetic(args) -> None:
         "device_count": jax.device_count(),
         **gen_meta,
     }
+    tuned = json.loads(args.tuned) if args.tuned else None
+    if tuned:
+        # the sweep sized warmup/decay to ITS horizon; rescale to this
+        # run's matched step count or the cosine would end a fifth of the
+        # way through training (the sweep runs 1M records, this runs 5M)
+        tuned = _rescale_schedule(
+            tuned, (len(train_ds) // args.batch_size) * 1
+        )
+        meta["tuned_optimizer"] = tuned
     print(json.dumps(meta), file=sys.stderr)
     kw = dict(batch_size=args.batch_size,
               eval_every_steps=args.eval_every_steps)
@@ -439,6 +458,20 @@ def run_synthetic(args) -> None:
             train_ds, eval_ds, variant=variant, seed=0, **kw
         )
         results[variant] = {"curve": curve, "seconds": secs}
+    if tuned:
+        for s in range(args.seeds):
+            curve, secs = run_matched_steps(
+                train_ds, eval_ds, variant="dense", seed=s,
+                opt_overrides=tuned, **kw
+            )
+            results[f"dense_tuned_seed{s}"] = {
+                "curve": curve, "seconds": secs, "opt": tuned}
+        curve, secs = run_matched_steps(
+            train_ds, eval_ds, variant="lazy", seed=0,
+            opt_overrides=tuned, **kw
+        )
+        results["lazy_tuned"] = {"curve": curve, "seconds": secs,
+                                 "opt": tuned}
 
     payload = {"meta": meta, "results": results}
     os.makedirs(args.out, exist_ok=True)
@@ -450,10 +483,78 @@ def run_synthetic(args) -> None:
                       "final_eval_auc": finals}))
 
 
+def _rescale_schedule(opt: dict, steps: int) -> dict:
+    """Re-derive warmup/decay for a new training horizon, keeping the
+    schedule SHAPE a sweep picked (same warmup fraction, decay to the end
+    of training)."""
+    if opt.get("lr_schedule", "constant") == "constant":
+        return opt
+    out = dict(opt)
+    out["decay_steps"] = steps
+    out["warmup_steps"] = max(100, steps // 20)
+    return out
+
+
+def run_opt_sweep(args) -> None:
+    """Pick the schedule/lr-split settings for the 5M study on a smaller
+    synthetic set (same generator, seed 7): one seed per candidate, final
+    eval only.  Writes docs/convergence_opt_sweep.json."""
+    train_ds, eval_ds, gen_meta = make_synthetic(args.records, seed=7)
+    steps = (len(train_ds) // args.batch_size) * args.epochs
+    warm = max(100, steps // 20)
+    candidates = {
+        "base": {},
+        "lr_2x": {"learning_rate": 1e-3},
+        "emb_4x": {"embedding_lr_multiplier": 4.0},
+        "emb_16x": {"embedding_lr_multiplier": 16.0},
+        "cosine": {"lr_schedule": "cosine", "warmup_steps": warm,
+                   "decay_steps": steps, "lr_end_fraction": 0.05},
+        "cosine_lr2x": {"learning_rate": 1e-3, "lr_schedule": "cosine",
+                        "warmup_steps": warm, "decay_steps": steps,
+                        "lr_end_fraction": 0.05},
+        "cosine_emb4": {"lr_schedule": "cosine", "warmup_steps": warm,
+                        "decay_steps": steps, "lr_end_fraction": 0.05,
+                        "embedding_lr_multiplier": 4.0},
+        "cosine_lr2x_emb4": {"learning_rate": 1e-3, "lr_schedule": "cosine",
+                             "warmup_steps": warm, "decay_steps": steps,
+                             "lr_end_fraction": 0.05,
+                             "embedding_lr_multiplier": 4.0},
+    }
+    results = {}
+    for name, opt in candidates.items():
+        for variant in ("dense", "lazy"):
+            curve, secs = run_matched_steps(
+                train_ds, eval_ds, variant=variant, seed=0,
+                batch_size=args.batch_size, eval_every_steps=10**9,
+                opt_overrides=opt or None, epochs=args.epochs,
+            )
+            key = f"{variant}:{name}"
+            results[key] = {"final": curve[-1], "seconds": secs, "opt": opt}
+            print(json.dumps({key: curve[-1]["eval_auc"]}), file=sys.stderr)
+    payload = {
+        "meta": {
+            "records": args.records, "epochs": args.epochs,
+            "batch_size": args.batch_size, "steps": steps,
+            **gen_meta,
+        },
+        "results": results,
+    }
+    os.makedirs(args.out, exist_ok=True)
+    with open(os.path.join(args.out, "convergence_opt_sweep.json"), "w") as f:
+        json.dump(payload, f, indent=1)
+    print(json.dumps({
+        "teacher_auc": gen_meta["teacher_bayes_auc_eval"],
+        "finals": {k: r["final"]["eval_auc"] for k, r in results.items()},
+    }))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--dataset", choices=("bundled", "synthetic"),
+    ap.add_argument("--dataset", choices=("bundled", "synthetic", "sweep"),
                     default="bundled")
+    ap.add_argument("--tuned", default=None,
+                    help="JSON optimizer-override dict (from --dataset "
+                         "sweep) to run as dense_tuned/lazy_tuned rows")
     ap.add_argument("--records", type=int, default=5_000_000)
     ap.add_argument("--seeds", type=int, default=3)
     ap.add_argument("--eval-every-steps", type=int, default=1200)
@@ -463,6 +564,15 @@ def main() -> None:
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "docs"))
     args = ap.parse_args()
+    if args.dataset == "sweep":
+        if args.batch_size == 512:
+            args.batch_size = 1024
+        if args.records == 5_000_000:
+            args.records = 1_000_000  # sweep default: 1/5 scale
+        if args.epochs == 60:
+            args.epochs = 1  # 60 is the bundled-10k default; sweep = 1 pass
+        run_opt_sweep(args)
+        return
     if args.dataset == "synthetic":
         if args.batch_size == 512:
             args.batch_size = 1024  # flagship batch for the 5M run

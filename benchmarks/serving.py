@@ -65,7 +65,7 @@ def main() -> None:
     platform, device_kind = bu.backend_platform()
 
     from deepfm_tpu.serve.export import load_servable
-    from deepfm_tpu.serve.server import Scorer, make_handler
+    from deepfm_tpu.serve.server import BatchingScorer, Scorer, make_handler
 
     rows = []
     rng = np.random.default_rng(0)
@@ -98,7 +98,10 @@ def main() -> None:
         from http.server import ThreadingHTTPServer
 
         srv = ThreadingHTTPServer(
-            ("127.0.0.1", 0), make_handler(scorer, "deepfm")
+            # the product handler wraps the scorer in the micro-batching
+            # front (serve_forever does the same): concurrent requests
+            # coalesce into shared dispatches
+            ("127.0.0.1", 0), make_handler(BatchingScorer(scorer), "deepfm")
         )
         t = threading.Thread(target=srv.serve_forever, daemon=True)
         t.start()
@@ -132,6 +135,53 @@ def main() -> None:
                     "layer": "http", "client_batch": cb,
                     "p50_ms_est": round(1e3 * dt / n_req, 3),
                     "rows_per_sec": round(n_req * cb / dt, 1),
+                })
+                print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
+
+            # concurrent batch-1 clients: the micro-batching front's regime
+            # (round-3 finding: serialized per-request dispatches cost 12x
+            # at b=1; coalescing shares dispatches across clients)
+            for n_clients in (4, 16):
+                ids, vals = batch(1)
+                body = json.dumps({
+                    "instances": [{"feat_ids": ids[0].tolist(),
+                                   "feat_vals": vals[0].tolist()}]
+                })
+                per_client = max(5, args.requests // (4 * n_clients))
+                lat: list[float] = []
+                lat_lock = threading.Lock()
+
+                def client():
+                    conn = http.client.HTTPConnection("127.0.0.1", port)
+                    mine = []
+                    for _ in range(per_client):
+                        t1 = time.perf_counter()
+                        conn.request(
+                            "POST", "/v1/models/deepfm:predict", body,
+                            {"Content-Type": "application/json"})
+                        r = conn.getresponse()
+                        payload = r.read()
+                        assert r.status == 200, payload[:200]
+                        mine.append(time.perf_counter() - t1)
+                    conn.close()
+                    with lat_lock:
+                        lat.extend(mine)
+
+                threads = [threading.Thread(target=client)
+                           for _ in range(n_clients)]
+                t0 = time.perf_counter()
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join()
+                dt = time.perf_counter() - t0
+                lat.sort()
+                rows.append({
+                    "layer": "http_concurrent", "client_batch": 1,
+                    "clients": n_clients,
+                    "p50_ms": round(1e3 * lat[len(lat) // 2], 3),
+                    "p95_ms": round(1e3 * lat[int(len(lat) * 0.95)], 3),
+                    "rows_per_sec": round(n_clients * per_client / dt, 1),
                 })
                 print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
         finally:

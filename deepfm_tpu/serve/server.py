@@ -74,6 +74,22 @@ def _apply_fixed_batch(
     return out
 
 
+def _instances_to_arrays(instances: list[dict]) -> tuple[np.ndarray, np.ndarray]:
+    ids = np.asarray([inst["feat_ids"] for inst in instances], np.int64)
+    vals = np.asarray([inst["feat_vals"] for inst in instances], np.float32)
+    return ids, vals
+
+
+def _check_features(ids: np.ndarray, vals: np.ndarray, fields: int) -> None:
+    """Reject malformed [N, F] pairs with one shared message shape."""
+    if ids.ndim != 2 or ids.shape[1] != fields:
+        raise ValueError(f"expected [N, {fields}] features, got {ids.shape}")
+    if vals.shape != ids.shape:
+        raise ValueError(
+            f"feat_vals shape {vals.shape} != feat_ids shape {ids.shape}"
+        )
+
+
 class Scorer:
     """Fixed-batch wrapper over the servable predict closure."""
 
@@ -91,11 +107,78 @@ class Scorer:
         )
 
     def score_instances(self, instances: list[dict]) -> np.ndarray:
-        ids = np.asarray([inst["feat_ids"] for inst in instances], np.int64)
-        vals = np.asarray(
-            [inst["feat_vals"] for inst in instances], np.float32
-        )
-        return self.score(ids, vals)
+        return self.score(*_instances_to_arrays(instances))
+
+
+class BatchingScorer:
+    """Cross-request micro-batching front (the TF-Serving batching-config
+    role).  Round-3 measurement: the HTTP layer served batch-1 requests at
+    12× the scorer's cost because every request paid its own dispatch
+    behind the scorer lock (`docs/BENCH_SERVING.json`).  Here concurrent
+    requests coalesce by BACKPRESSURE, with zero added idle latency: a
+    worker thread drains everything queued, stacks it into one fixed-batch
+    dispatch, and fans the slices back.  A lone request dispatches
+    immediately (worker idle -> drains a queue of one); requests arriving
+    while the device is busy pile up and share the next dispatch.
+
+    Same interface as Scorer; shape validation happens on the caller's
+    thread so a malformed request fails alone, never poisoning a batch.
+    """
+
+    def __init__(self, scorer: Scorer, max_rows_per_dispatch: int = 4096):
+        self._scorer = scorer
+        self._max_rows = max_rows_per_dispatch
+        self._cond = threading.Condition()
+        self._queue: list[dict] = []
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def score(self, ids: np.ndarray, vals: np.ndarray) -> np.ndarray:
+        ids = np.asarray(ids, np.int64)
+        vals = np.asarray(vals, np.float32)
+        # full pair validation HERE, on the caller's thread: a malformed
+        # request (including a vals/ids mismatch) must fail alone, never
+        # reach the shared queue, and never skew another caller's offsets
+        _check_features(ids, vals, self._scorer._fields)
+        if ids.shape[0] == 0:
+            return np.zeros((0,), np.float32)
+        item = {"ids": ids, "vals": vals, "done": threading.Event()}
+        with self._cond:
+            self._queue.append(item)
+            self._cond.notify()
+        item["done"].wait()
+        if "error" in item:
+            raise item["error"]
+        return item["result"]
+
+    def score_instances(self, instances: list[dict]) -> np.ndarray:
+        return self.score(*_instances_to_arrays(instances))
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue:
+                    self._cond.wait()
+                batch, rows = [], 0
+                while self._queue and rows < self._max_rows:
+                    batch.append(self._queue.pop(0))
+                    rows += batch[-1]["ids"].shape[0]
+            try:
+                probs = self._scorer.score(
+                    np.concatenate([b["ids"] for b in batch]),
+                    np.concatenate([b["vals"] for b in batch]),
+                )
+                off = 0
+                for b in batch:
+                    n = b["ids"].shape[0]
+                    b["result"] = probs[off : off + n]
+                    off += n
+            except Exception as e:  # runtime failure: fail the whole batch
+                for b in batch:
+                    b["error"] = e
+            finally:
+                for b in batch:
+                    b["done"].set()
 
 
 class RetrievalScorer:
@@ -322,7 +405,9 @@ def serve_forever(
                 f"{servable_dir!r} holds {cfg.model.model_name!r}"
             )
         predict, cfg = load_servable(servable_dir)
-        scorer = Scorer(predict, cfg.model.field_size, batch_size)
+        # micro-batching front: concurrent requests share dispatches
+        # (backpressure coalescing, no idle latency — see BatchingScorer)
+        scorer = BatchingScorer(Scorer(predict, cfg.model.field_size, batch_size))
         handler = make_handler(scorer, model_name)
         endpoint = "predict"
     httpd = ThreadingHTTPServer((host, port), handler)

@@ -60,6 +60,56 @@ def test_scorer_matches_direct_predict(servable_dir):
     np.testing.assert_allclose(got, want, rtol=1e-6)
 
 
+def test_batching_scorer_concurrent_correctness(servable_dir):
+    """32 concurrent single-row requests through the micro-batching front:
+    every caller gets ITS row's probability (slices fan back to the right
+    request), equal to the direct scorer result; malformed shapes fail on
+    the caller's thread without poisoning anyone's batch."""
+    from deepfm_tpu.serve.server import BatchingScorer
+
+    predict, cfg = load_servable(servable_dir)
+    scorer = Scorer(predict, cfg.model.field_size, batch_size=8)
+    front = BatchingScorer(scorer)
+    inst = _instances(32, seed=2)
+    ids = np.asarray([i["feat_ids"] for i in inst], np.int64)
+    vals = np.asarray([i["feat_vals"] for i in inst], np.float32)
+    want = np.asarray(predict(ids, vals))
+
+    results: dict[int, np.ndarray] = {}
+    errors: list[Exception] = []
+    lock = threading.Lock()
+
+    def one(i):
+        try:
+            r = front.score(ids[i : i + 1], vals[i : i + 1])
+            with lock:
+                results[i] = r
+        except Exception as e:  # pragma: no cover - failure reporting
+            with lock:
+                errors.append(e)
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(32)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    got = np.concatenate([results[i] for i in range(32)])
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    # malformed request fails alone, front still serves afterwards
+    with pytest.raises(ValueError, match="expected"):
+        front.score(np.zeros((2, 3), np.int64), np.zeros((2, 3), np.float32))
+    np.testing.assert_allclose(
+        front.score(ids[:1], vals[:1]), want[:1], rtol=1e-6
+    )
+    # empty request short-circuits
+    assert front.score(
+        np.zeros((0, cfg.model.field_size), np.int64),
+        np.zeros((0, cfg.model.field_size), np.float32),
+    ).shape == (0,)
+
+
 def test_rest_endpoint_tf_serving_shape(servable_dir):
     ready = threading.Event()
     t = threading.Thread(
