@@ -433,18 +433,21 @@ def run_synthetic(args) -> None:
         "device_count": jax.device_count(),
         **gen_meta,
     }
+    # every matched-steps variant in this study runs the same horizon; the
+    # tuned rescale below MUST use the same epochs value the runs use
+    study_epochs = 1
     tuned = json.loads(args.tuned) if args.tuned else None
     if tuned:
         # the sweep sized warmup/decay to ITS horizon; rescale to this
         # run's matched step count or the cosine would end a fifth of the
         # way through training (the sweep runs 1M records, this runs 5M)
         tuned = _rescale_schedule(
-            tuned, (len(train_ds) // args.batch_size) * 1
+            tuned, (len(train_ds) // args.batch_size) * study_epochs
         )
         meta["tuned_optimizer"] = tuned
     print(json.dumps(meta), file=sys.stderr)
     kw = dict(batch_size=args.batch_size,
-              eval_every_steps=args.eval_every_steps)
+              eval_every_steps=args.eval_every_steps, epochs=study_epochs)
     results = {}
     for s in range(args.seeds):
         curve, secs = run_matched_steps(
@@ -564,6 +567,9 @@ def main() -> None:
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "docs"))
     args = ap.parse_args()
+    if args.tuned and args.dataset != "synthetic":
+        ap.error("--tuned only applies to --dataset synthetic (it adds "
+                 "dense_tuned/lazy_tuned rows to the matched-steps study)")
     if args.dataset == "sweep":
         if args.batch_size == 512:
             args.batch_size = 1024

@@ -21,15 +21,26 @@ from dataclasses import dataclass, field
 from typing import Any, Sequence
 
 
+def _strip_list_wrappers(s: str) -> str:
+    # accept "(8,4)" / "[8,4]" alongside the canonical "8,4" — users paste
+    # python tuples into --set and the bare int() error was baffling
+    return s.strip().removeprefix("(").removeprefix("[") \
+            .removesuffix(")").removesuffix("]")
+
+
 def _parse_int_list(s: str | Sequence[int]) -> tuple[int, ...]:
     if isinstance(s, str):
-        return tuple(int(x) for x in s.split(",") if x.strip())
+        return tuple(
+            int(x) for x in _strip_list_wrappers(s).split(",") if x.strip()
+        )
     return tuple(int(x) for x in s)
 
 
 def _parse_float_list(s: str | Sequence[float]) -> tuple[float, ...]:
     if isinstance(s, str):
-        return tuple(float(x) for x in s.split(",") if x.strip())
+        return tuple(
+            float(x) for x in _strip_list_wrappers(s).split(",") if x.strip()
+        )
     return tuple(float(x) for x in s)
 
 

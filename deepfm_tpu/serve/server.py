@@ -53,8 +53,7 @@ def _apply_fixed_batch(
     """Run ``fn(ids, vals)`` over [N, F] inputs in fixed-size chunks, zero-
     padding the tail so XLA compiles exactly one executable.  Output may be
     [B] (probabilities) or [B, D] (embeddings)."""
-    if ids.ndim != 2 or ids.shape[1] != fields:
-        raise ValueError(f"expected [N, {fields}] features, got {ids.shape}")
+    _check_features(ids, vals, fields)
     n = ids.shape[0]
     out = None
     with lock:

@@ -59,6 +59,17 @@ def test_resolve_config_flags_and_sets(tmp_path):
     assert cfg.model.batch_norm is True
 
 
+def test_list_values_accept_tuple_and_bracket_spellings():
+    """Users paste python tuples into --set; "(8,4)" and "[8,4]" must parse
+    like the canonical "8,4" (both int and float lists)."""
+    cfg, _ = resolve_config(
+        ["--no_env", "--set", "model.deep_layers=(8,4)",
+         "--set", "model.dropout_keep=[0.9,0.8]"]
+    )
+    assert cfg.model.deep_layers == (8, 4)
+    assert cfg.model.dropout_keep == (0.9, 0.8)
+
+
 def test_resolve_config_from_json_file(tmp_path):
     path = tmp_path / "cfg.json"
     path.write_text(json.dumps({"model": {"embedding_size": 16}}))

@@ -135,6 +135,32 @@ def test_sigterm_during_setup_exits_cleanly(data_dir, tmp_path, monkeypatch):
     ckpt.close()
 
 
+def test_second_signal_escalates_to_default_kill():
+    """While a graceful stop is pending, a REPEATED signal must terminate
+    the process immediately (default handling) — the only way out of a
+    wedged setup without SIGKILL.  Run in a subprocess: the escalation
+    kills the interpreter."""
+    import subprocess
+    import sys as _sys
+
+    code = r"""
+import os, signal, sys, time
+sys.path.insert(0, %r)
+from deepfm_tpu.launch.preemption import PreemptionGuard
+with PreemptionGuard() as g:
+    os.kill(os.getpid(), signal.SIGTERM)   # graceful: sets the flag
+    assert g.should_stop
+    print("FIRST_OK", flush=True)
+    os.kill(os.getpid(), signal.SIGTERM)   # repeated: escalates, dies here
+    print("UNREACHABLE", flush=True)
+""" % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run([_sys.executable, "-c", code],
+                       capture_output=True, text=True, timeout=60)
+    assert "FIRST_OK" in r.stdout
+    assert "UNREACHABLE" not in r.stdout
+    assert r.returncode == -signal.SIGTERM  # died by the default handler
+
+
 def test_run_with_restarts_retries_then_succeeds():
     calls = {"n": 0}
     restarts = []
