@@ -100,6 +100,18 @@ def backend_platform() -> tuple[str, str]:
     return platform, jax.devices()[0].device_kind
 
 
+def rescale_schedule(opt: dict, steps: int) -> dict:
+    """Re-derive warmup/decay for a new training horizon, keeping the
+    schedule SHAPE a sweep picked (same ~5% warmup fraction, decay to the
+    end of training).  No-op for constant-lr dicts."""
+    if opt.get("lr_schedule", "constant") == "constant":
+        return opt
+    out = dict(opt)
+    out["decay_steps"] = steps
+    out["warmup_steps"] = max(100, steps // 20)
+    return out
+
+
 def persist_latest_runs(path: str, out: dict, *, ok: int,
                         platform: str | None) -> None:
     """The single persist policy: {latest, runs} history; keep the previous

@@ -441,7 +441,9 @@ def run_synthetic(args) -> None:
         # the sweep sized warmup/decay to ITS horizon; rescale to this
         # run's matched step count or the cosine would end a fifth of the
         # way through training (the sweep runs 1M records, this runs 5M)
-        tuned = _rescale_schedule(
+        import _bench_util as bu
+
+        tuned = bu.rescale_schedule(
             tuned, (len(train_ds) // args.batch_size) * study_epochs
         )
         meta["tuned_optimizer"] = tuned
@@ -484,18 +486,6 @@ def run_synthetic(args) -> None:
     finals = {k: r["curve"][-1]["eval_auc"] for k, r in results.items()}
     print(json.dumps({"teacher_auc": gen_meta["teacher_bayes_auc_eval"],
                       "final_eval_auc": finals}))
-
-
-def _rescale_schedule(opt: dict, steps: int) -> dict:
-    """Re-derive warmup/decay for a new training horizon, keeping the
-    schedule SHAPE a sweep picked (same warmup fraction, decay to the end
-    of training)."""
-    if opt.get("lr_schedule", "constant") == "constant":
-        return opt
-    out = dict(opt)
-    out["decay_steps"] = steps
-    out["warmup_steps"] = max(100, steps // 20)
-    return out
 
 
 def run_opt_sweep(args) -> None:

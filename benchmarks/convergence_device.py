@@ -150,6 +150,10 @@ def main() -> None:
     p.add_argument("--seed", type=int, default=0,
                    help="student init + data-stream seed (teacher stays "
                         "seed-0 so every run shares the same planted task)")
+    p.add_argument("--opt", default=None,
+                   help="JSON optimizer-override dict (e.g. the winner of "
+                        "convergence.py --dataset sweep); schedule horizon "
+                        "is rescaled to THIS run's total steps")
     p.add_argument("--persist", action="store_true")
     args = p.parse_args()
 
@@ -169,13 +173,19 @@ def main() -> None:
     bias = calibrate_bias(teacher)
     synth = make_synth_fn(teacher, bias)
 
+    opt = {"learning_rate": 0.0005,
+           "lazy_embedding_updates": bool(args.lazy)}
+    if args.opt:
+        import _bench_util as bu
+
+        total_steps = max(1, args.records_per_epoch // args.batch) * args.epochs
+        opt.update(bu.rescale_schedule(json.loads(args.opt), total_steps))
     cfg = Config.from_dict({
         "model": {
             "feature_size": V, "field_size": FIELDS, "embedding_size": 32,
             "deep_layers": (128, 64, 32), "dropout_keep": (0.5, 0.5, 0.5),
         },
-        "optimizer": {"learning_rate": 0.0005,
-                      "lazy_embedding_updates": bool(args.lazy)},
+        "optimizer": opt,
         "data": {"batch_size": args.batch},
     })
     import jax.random as jrandom
@@ -265,6 +275,8 @@ def main() -> None:
         "steps_per_epoch": steps_per_epoch,
         "variant": "lazy_adam" if args.lazy else "dense_xla",
         "seed": args.seed,
+        "optimizer": {k: v for k, v in opt.items()
+                      if k != "lazy_embedding_updates"},
         "teacher_bias": round(float(bias), 4),
         "setup_secs": round(setup_s, 2),
         "eval_records": args.eval_batches * args.batch,
