@@ -512,7 +512,26 @@ def run_opt_sweep(args) -> None:
                              "warmup_steps": warm, "decay_steps": steps,
                              "lr_end_fraction": 0.05,
                              "embedding_lr_multiplier": 4.0},
+        # round-2 candidates: the first sweep showed the emb split dominates
+        # and cosine only helps once lr is raised — probe the constant-lr
+        # corner of that region plus hotter combinations
+        "lr2x_emb4": {"learning_rate": 1e-3,
+                      "embedding_lr_multiplier": 4.0},
+        "lr2x_emb8": {"learning_rate": 1e-3,
+                      "embedding_lr_multiplier": 8.0},
+        "lr4x_emb4": {"learning_rate": 2e-3,
+                      "embedding_lr_multiplier": 4.0},
+        "cosine_lr4x_emb4": {"learning_rate": 2e-3, "lr_schedule": "cosine",
+                             "warmup_steps": warm, "decay_steps": steps,
+                             "lr_end_fraction": 0.05,
+                             "embedding_lr_multiplier": 4.0},
     }
+    if args.only:
+        keep = set(args.only.split(","))
+        unknown = keep - set(candidates)
+        if unknown:
+            raise SystemExit(f"--only: unknown candidates {sorted(unknown)}")
+        candidates = {k: v for k, v in candidates.items() if k in keep}
     results = {}
     for name, opt in candidates.items():
         for variant in ("dense", "lazy"):
@@ -524,16 +543,34 @@ def run_opt_sweep(args) -> None:
             key = f"{variant}:{name}"
             results[key] = {"final": curve[-1], "seconds": secs, "opt": opt}
             print(json.dumps({key: curve[-1]["eval_auc"]}), file=sys.stderr)
-    payload = {
-        "meta": {
-            "records": args.records, "epochs": args.epochs,
-            "batch_size": args.batch_size, "steps": steps,
-            **gen_meta,
-        },
-        "results": results,
-    }
     os.makedirs(args.out, exist_ok=True)
-    with open(os.path.join(args.out, "convergence_opt_sweep.json"), "w") as f:
+    path = os.path.join(args.out, "convergence_opt_sweep.json")
+    meta = {"records": args.records, "epochs": args.epochs,
+            "batch_size": args.batch_size, "steps": steps, **gen_meta}
+    prev: dict = {}
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                prev_payload = json.load(f)
+            prev_meta = prev_payload.get("meta", {})
+            # rows are only comparable under the same data/horizon; merging
+            # across configs would misattribute old rows to the new meta
+            if all(prev_meta.get(k) == meta[k]
+                   for k in ("records", "epochs", "batch_size", "steps")):
+                prev = prev_payload.get("results", {})
+            elif args.only:
+                raise SystemExit(
+                    f"--only merge refused: existing sweep at {path} ran "
+                    f"{ {k: prev_meta.get(k) for k in ('records', 'epochs', 'batch_size')} }, "
+                    f"this run is { {k: meta[k] for k in ('records', 'epochs', 'batch_size')} } "
+                    f"— rerun the full sweep or match the config"
+                )
+        except SystemExit:
+            raise
+        except Exception:
+            prev = {}
+    payload = {"meta": meta, "results": {**prev, **results}}
+    with open(path, "w") as f:
         json.dump(payload, f, indent=1)
     print(json.dumps({
         "teacher_auc": gen_meta["teacher_bayes_auc_eval"],
@@ -548,6 +585,9 @@ def main() -> None:
     ap.add_argument("--tuned", default=None,
                     help="JSON optimizer-override dict (from --dataset "
                          "sweep) to run as dense_tuned/lazy_tuned rows")
+    ap.add_argument("--only", default=None,
+                    help="sweep mode: comma-separated candidate names to "
+                         "(re)run; results merge into the artifact")
     ap.add_argument("--records", type=int, default=5_000_000)
     ap.add_argument("--seeds", type=int, default=3)
     ap.add_argument("--eval-every-steps", type=int, default=1200)
@@ -716,6 +756,28 @@ def write_md(out_dir: str) -> None:
             "typically converges a touch FASTER (rare rows keep full-size "
             "updates); a gap above the dense band in its favor is the "
             "expected signature, not a parity failure.",
+        ]
+        tuned_finals = [
+            r["curve"][-1]["eval_auc"]
+            for k, r in results.items() if k.startswith("dense_tuned_seed")
+        ]
+        if tuned_finals:
+            tuned_spread = max(tuned_finals) - min(tuned_finals)
+            gain = min(tuned_finals) - max(dense_finals)
+            ceiling = meta["teacher_bayes_auc_eval"]
+            lines += [
+                f"- **Tuned optimizer** ({json.dumps(meta.get('tuned_optimizer', {}))}, "
+                "picked by `--dataset sweep`, `docs/convergence_opt_sweep.json`): "
+                f"dense_tuned final {min(tuned_finals):.4f}-"
+                f"{max(tuned_finals):.4f} (spread {tuned_spread:.4f}, "
+                f"{len(tuned_finals)} seeds) vs base dense band "
+                f"[{min(dense_finals):.4f}, {max(dense_finals):.4f}] — "
+                f"worst-seed gain {gain:+.4f}; remaining gap to the "
+                f"{ceiling:.4f} ceiling: "
+                f"{ceiling - max(tuned_finals):.4f} (was "
+                f"{ceiling - max(dense_finals):.4f}).",
+            ]
+        lines += [
             "",
             "Full curves: `docs/convergence_synthetic.json`.",
             "",
@@ -763,7 +825,57 @@ def write_md(out_dir: str) -> None:
             "(ops/auc.py).",
             "",
             "Full curves: `docs/convergence_results.json`.",
+            "",
         ]
+
+    dev_path = os.path.join(out_dir, "BENCH_CONVERGENCE_DEVICE.json")
+    if os.path.exists(dev_path):
+        with open(dev_path) as f:
+            dev = json.load(f)
+        latest = dev.get("latest", dev)
+        eps = latest.get("epochs", [])
+        if eps:
+            aucs = " → ".join(f"{e['eval_auc']:.4f}" for e in eps)
+            ceiling = eps[-1]["teacher_bayes_auc"]
+            gap = eps[-1]["auc_gap_to_bayes"]
+            total = sum(e["records"] for e in eps)
+            opt = latest.get("optimizer", {})
+            is_default = (
+                opt.get("lr_schedule", "constant") == "constant"
+                and opt.get("embedding_lr_multiplier", 1.0) == 1.0
+                and opt.get("warmup_steps", 0) == 0
+                and opt.get("learning_rate", 0.0005) == 0.0005
+            )
+            opt_note = (
+                " (flat Adam 5e-4)" if is_default
+                else f"; optimizer `{json.dumps(opt)}`"
+            )
+            lines += [
+                "## 3. On-device study at Criteo-Kaggle scale",
+                "",
+                "`python benchmarks/convergence_device.py` — the SAME "
+                "planted-teacher generative process as §1, re-expressed as "
+                "pure JAX so every batch is synthesized **on-chip inside a "
+                "`lax.scan` epoch**: zero per-step host dispatch, which "
+                "unlocks BASELINE config #2's scale (45M records/epoch) on "
+                "one chip regardless of host/feed speed.  The device "
+                "teacher's Bayes AUC matches §1's host teacher, tying both "
+                "studies to the same ceiling (Zipf tail by inverse-CDF "
+                "approximation, bias re-calibrated against the device "
+                "sampler; the artifact records it).",
+                "",
+                f"Latest committed run (`docs/BENCH_CONVERGENCE_DEVICE.json`"
+                f", platform **{latest.get('platform')}**): "
+                f"{total / 1e6:.0f}M total records, batch "
+                f"{latest.get('batch')}, eval AUC {aucs} against the "
+                f"{ceiling:.5f} Bayes ceiling — final gap {gap:.4f}"
+                f"{opt_note}.  Earlier runs (2M-scale ramp, a 3-seed "
+                "matched set with early-training spread 0.0097 — the seed "
+                "yardstick at that scale; §1's converged yardstick is "
+                "0.0007) live in the artifact's `runs` history.  A "
+                "real-TPU `latest` is never demoted by CPU fallback runs; "
+                "TPU rows land via `benchmarks/tpu_session.sh`.",
+            ]
     with open(os.path.join(out_dir, "CONVERGENCE.md"), "w") as f:
         f.write("\n".join(lines) + "\n")
 

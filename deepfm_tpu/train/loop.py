@@ -311,6 +311,23 @@ def _run_train_guarded(cfg: Config, guard: PreemptionGuard) -> TrainState:
     # the just-dispatched step and defeat async-dispatch pipelining
     step = int(state.step)
     log.seed_step(step)
+    # when a schedule is active, surface the live lr on each logged line
+    # (evaluated only on emitting calls — MetricLogger.step `extra`).
+    # ctx.cfg, not cfg: make_context resolved mesh.data_parallel (the raw
+    # config may carry the -1 auto sentinel).  The last update in the
+    # logged window ran at schedule(step - 1) — optax and the lazy path
+    # both evaluate the schedule at the PRE-increment count — so that is
+    # the value reported.
+    from ..train.optimizer import build_lr_schedule, schedule_value
+
+    lr_sched = build_lr_schedule(
+        ctx.cfg.optimizer, data_parallel_size=ctx.cfg.mesh.data_parallel
+    )
+    lr_extra = (
+        (lambda: {"lr": float(schedule_value(lr_sched, max(0, step - 1)))})
+        if callable(lr_sched)
+        else None
+    )
     # periodic in-training eval, the train_and_evaluate cadence (ps:510-520):
     # no eval before start_delay, then at most one per throttle interval.
     # 0/0 (default) means end-of-training eval only — the reference's values
@@ -347,8 +364,10 @@ def _run_train_guarded(cfg: Config, guard: PreemptionGuard) -> TrainState:
             if cpu_serial:
                 jax.block_until_ready(metrics)
             step += inc
-            log.step(step, batch_size, {k: v for k, v in metrics.items()
-                                        if k != "loss_per_shard"})
+            log.step(step, batch_size,
+                     {k: v for k, v in metrics.items()
+                      if k != "loss_per_shard"},
+                     extra=lr_extra)
             # boundary-crossing test: a K-step dispatch may jump past the
             # exact multiple (identical to `step % N == 0` when inc == 1)
             if ckpt_every and step // ckpt_every > (step - inc) // ckpt_every:

@@ -39,11 +39,20 @@ class MetricLogger:
         self._step_at_last_log = step
         self._t_last = time.perf_counter()
 
-    def step(self, step: int, batch_size: int, metrics: Mapping[str, Any]) -> None:
+    def step(
+        self,
+        step: int,
+        batch_size: int,
+        metrics: Mapping[str, Any],
+        extra=None,
+    ) -> None:
         """``batch_size`` = examples consumed since the previous call (K·B
         when a multi-step dispatch advanced ``step`` by K).  Logs whenever a
         ``log_steps`` boundary was crossed since the last log — robust to
-        step increments that never land exactly on a multiple."""
+        step increments that never land exactly on a multiple.  ``extra``
+        (optional zero-arg callable returning a dict) is evaluated ONLY on
+        emitting calls, so per-log-only quantities (e.g. the scheduled lr)
+        cost nothing on the non-logging fast path."""
         self._examples_since += batch_size
         if step // self.log_steps <= self._step_at_last_log // self.log_steps:
             return
@@ -58,6 +67,8 @@ class MetricLogger:
                 1000 * dt / max(1, step - self._step_at_last_log), 3
             ),
         }
+        if extra is not None:
+            metrics = {**metrics, **extra()}
         for k, v in metrics.items():
             try:
                 record[k] = round(float(v), 6)

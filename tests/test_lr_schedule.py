@@ -212,6 +212,42 @@ def test_lazy_schedule_equals_stepwise_constant_lr():
             rtol=1e-6, atol=1e-7)
 
 
+@pytest.mark.parametrize("lazy", [False, True])
+def test_schedule_survives_checkpoint_resume(tmp_path, lazy):
+    """Save at step 2 of a cosine schedule, restore, continue 2 steps: the
+    trajectory must equal 4 uninterrupted steps — i.e. the restored run
+    picks the schedule up at step 2, not step 0 (dense: optax count in
+    opt_state; lazy: state.step)."""
+    from deepfm_tpu.checkpoint import Checkpointer
+
+    sched = dict(lr_schedule="cosine", warmup_steps=1, decay_steps=4,
+                 lr_end_fraction=0.1, lazy_embedding_updates=lazy)
+    key = jax.random.PRNGKey(5)
+    batches = [_batch(i) for i in range(4)]
+    cfg = _cfg(**sched)
+    step = jax.jit(make_train_step(cfg))
+
+    straight = create_train_state(cfg, key)
+    for b in batches:
+        straight, _ = step(straight, b)
+
+    first = create_train_state(cfg, key)
+    for b in batches[:2]:
+        first, _ = step(first, b)
+    ck = Checkpointer(str(tmp_path / "ck"))
+    assert ck.save(first, block=True)
+    resumed = ck.restore(create_train_state(cfg, key))
+    ck.close()
+    assert int(resumed.step) == 2
+    for b in batches[2:]:
+        resumed, _ = step(resumed, b)
+
+    for k in ("fm_v", "fm_w"):
+        np.testing.assert_allclose(
+            np.asarray(straight.params[k]), np.asarray(resumed.params[k]),
+            rtol=1e-6, atol=1e-7, err_msg=f"lazy={lazy} {k}")
+
+
 def test_spmd_lazy_schedule_matches_single_controller():
     """The SPMD lazy step evaluates lr_sched(state.step) inside shard_map
     (parallel/spmd.py _build_lazy_local_step); under a schedule its
