@@ -464,6 +464,16 @@ def run_synthetic(args) -> None:
                 if (pm.get("train_records") == len(train_ds)
                         and pm.get("batch_size") == args.batch_size):
                     results.update(prev.get("results", {}))
+                    if tuned and pm.get("tuned_optimizer") != tuned:
+                        # tuned rows from a DIFFERENT tuned config must
+                        # re-run, or the artifact's meta would mislabel them
+                        stale = [k for k in results
+                                 if k.startswith(("dense_tuned", "lazy_tuned"))]
+                        for k in stale:
+                            del results[k]
+                        if stale:
+                            print(f"re-running {len(stale)} tuned rows "
+                                  f"(tuned config changed)", file=sys.stderr)
                     print(f"reusing {len(results)} committed rows",
                           file=sys.stderr)
                 else:

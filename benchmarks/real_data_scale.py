@@ -200,17 +200,25 @@ def part_b_encoder(lines: int, batch_size: int, tmp: str) -> dict:
 
     enc_dir = os.path.join(tmp, "encoded")
     os.makedirs(enc_dir)
+    from deepfm_tpu import native
+
+    native.available()  # pre-build the C++ library OUTSIDE the timed region
     t0 = time.time()
     shards = convert_criteo_to_tfrecords(
         raw, enc_dir, CriteoHashEncoder(V), records_per_shard=lines // 8,
     )
     enc_secs = time.time() - t0
+    from deepfm_tpu import native
+
     out = {
         "raw_lines": lines,
         "raw_gen_secs": round(gen_secs, 1),
         "hash_encode_lines_per_sec": round(lines / enc_secs, 1),
         "encode_secs": round(enc_secs, 1),
         "shards": len(shards),
+        # the convert path auto-delegates to the C++ encoder when available
+        # (byte-identical output; tests/test_native.py)
+        "native_encoder": native.available(),
     }
 
     # the encoder's output trains: one epoch over a 2-shard subset through

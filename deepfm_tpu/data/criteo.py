@@ -165,7 +165,29 @@ def convert_criteo_to_tfrecords(
 
     Sharded output is what feeds the 4-way shard matrix (README.md:87-92):
     per-host file assignment needs file counts divisible by the host count
-    (README.md:67), which one giant file would preclude."""
+    (README.md:67), which one giant file would preclude.
+
+    Hash encoding delegates to the native C++ encoder when available
+    (``native/src/criteo_encoder.cc`` — byte-identical output, asserted in
+    tests/test_native.py; ~100x the Python line rate, which is what makes
+    the Criteo-1TB prep feasible).  ``DEEPFM_NO_NATIVE=1`` forces Python."""
+    if isinstance(encoder, CriteoHashEncoder):
+        from .. import native
+
+        if native.available():
+            n = native.criteo_hash_encode_file(
+                input_path, output_dir,
+                feature_size=encoder.feature_size,
+                records_per_shard=records_per_shard, prefix=prefix,
+            )
+            # exact shard names THIS run wrote (a glob would leak stale
+            # shards from an earlier, larger conversion into the same dir)
+            n_shards = (n + records_per_shard - 1) // records_per_shard
+            return [
+                os.path.join(os.fspath(output_dir),
+                             f"{prefix}-{i:05d}.tfrecords")
+                for i in range(n_shards)
+            ]
     os.makedirs(output_dir, exist_ok=True)
     paths: list[str] = []
     writer: TFRecordWriter | None = None

@@ -200,3 +200,129 @@ def test_reference_val_tfrecords_parity(reference_val_tfrecords):
     np.testing.assert_array_equal(batches[0]["feat_ids"], feats["feat_ids"])
     np.testing.assert_array_equal(batches[0]["feat_vals"], feats["feat_vals"])
     np.testing.assert_array_equal(batches[0]["label"], labels)
+
+
+# ---------------------------------------------------------------------------
+# Native Criteo hash encoder (criteo_encoder.cc)
+# ---------------------------------------------------------------------------
+
+
+def test_blake2b64_matches_hashlib():
+    import hashlib
+
+    for data in (b"", b"0:", b"5:68fd1e64", b"25:" + b"x" * 200,
+                 b"7:\xf0\x9f\x8c\x8d", b"a" * 128, b"b" * 129):
+        want = int.from_bytes(
+            hashlib.blake2b(data, digest_size=8).digest(), "little"
+        )
+        assert native.blake2b64(data) == want, data
+
+
+def _raw_tsv_lines(n, seed=0):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(n):
+        fields = [str(int(rng.random() < 0.3))]
+        fields += ["" if rng.random() < 0.1 else str(int(rng.integers(0, 9000)))
+                   for _ in range(13)]
+        fields += ["" if rng.random() < 0.15 else format(
+            int(rng.integers(0, 1 << 32)), "08x") for _ in range(26)]
+        lines.append("\t".join(fields))
+    return lines
+
+
+def test_criteo_hash_encode_byte_identical_to_python(tmp_path):
+    """The native encoder's shards must be BYTE-IDENTICAL to the Python
+    CriteoHashEncoder + convert_criteo_to_tfrecords output: same hash, same
+    proto bytes, same framing, same shard naming."""
+    from deepfm_tpu.data.criteo import (
+        CriteoHashEncoder,
+        convert_criteo_to_tfrecords,
+    )
+
+    raw = tmp_path / "raw.tsv"
+    raw.write_text("\n".join(_raw_tsv_lines(500)) + "\n\n")  # + blank line
+
+    py_dir = tmp_path / "py"
+    py_paths = convert_criteo_to_tfrecords(
+        raw, py_dir, CriteoHashEncoder(20_000), records_per_shard=200,
+    )
+    nat_dir = tmp_path / "nat"
+    n = native.criteo_hash_encode_file(
+        raw, nat_dir, feature_size=20_000, records_per_shard=200,
+    )
+    assert n == 500
+    assert len(py_paths) == 3
+    for p in py_paths:
+        q = os.path.join(nat_dir, os.path.basename(p))
+        with open(p, "rb") as f1, open(q, "rb") as f2:
+            assert f1.read() == f2.read(), f"shard differs: {p}"
+
+
+def test_criteo_hash_encode_reports_malformed(tmp_path):
+    raw = tmp_path / "bad.tsv"
+    raw.write_text("1\t5\tabc\n" + "not_a_label\t1\t2\n")
+    with pytest.raises(ValueError, match="malformed"):
+        native.criteo_hash_encode_file(
+            raw, tmp_path / "out", feature_size=20_000
+        )
+
+
+def test_criteo_hash_encode_crlf_and_pyfloat_parity(tmp_path):
+    """CRLF input (the Python path reads in text mode, so \r\n arrives as
+    \n — the native path strips the \r equivalently), whitespace-padded
+    numerics (float() tolerance), and exactly-40-field validation must all
+    match the Python encoder."""
+    from deepfm_tpu.data.criteo import (
+        CriteoHashEncoder,
+        convert_criteo_to_tfrecords,
+    )
+
+    good = "\t".join(["1"] + [" 5 "] * 13 + ["tok"] * 26)
+    lines = [good + "\r", good]          # CRLF-ish + plain
+    raw = tmp_path / "crlf.tsv"
+    raw.write_bytes(("\n".join(lines) + "\n").encode())
+
+    py_dir, nat_dir = tmp_path / "py", tmp_path / "nat"
+    convert_criteo_to_tfrecords(
+        raw, py_dir, CriteoHashEncoder(20_000))
+    os.environ["DEEPFM_NO_NATIVE"] = "1"
+    try:
+        # the native-path guard reads the env through native.available()
+        py2_dir = tmp_path / "py2"
+        convert_criteo_to_tfrecords(raw, py2_dir, CriteoHashEncoder(20_000))
+    finally:
+        del os.environ["DEEPFM_NO_NATIVE"]
+    a = (py_dir / "tr-00000.tfrecords").read_bytes()
+    b = (py2_dir / "tr-00000.tfrecords").read_bytes()
+    assert a == b  # native (if used) == pure python on CRLF input
+
+    # wrong field count (39 fields) and partial-parse label both reject
+    for bad in ("\t".join(["1"] + ["5"] * 12 + ["tok"] * 26),
+                "1abc\t" + "\t".join(["5"] * 13 + ["tok"] * 26)):
+        raw_bad = tmp_path / "bad.tsv"
+        raw_bad.write_text(bad + "\n")
+        with pytest.raises(ValueError):
+            native.criteo_hash_encode_file(
+                raw_bad, tmp_path / "outbad", feature_size=20_000)
+
+
+def test_criteo_hash_encode_no_stale_shards(tmp_path):
+    """A smaller re-conversion into the same dir must return only the
+    shards it wrote, not stale ones from an earlier run."""
+    from deepfm_tpu.data.criteo import (
+        CriteoHashEncoder,
+        convert_criteo_to_tfrecords,
+    )
+
+    out = tmp_path / "enc"
+    big = tmp_path / "big.tsv"
+    big.write_text("\n".join(_raw_tsv_lines(300)) + "\n")
+    paths = convert_criteo_to_tfrecords(
+        big, out, CriteoHashEncoder(20_000), records_per_shard=100)
+    assert len(paths) == 3
+    small = tmp_path / "small.tsv"
+    small.write_text("\n".join(_raw_tsv_lines(120, seed=1)) + "\n")
+    paths2 = convert_criteo_to_tfrecords(
+        small, out, CriteoHashEncoder(20_000), records_per_shard=100)
+    assert len(paths2) == 2
