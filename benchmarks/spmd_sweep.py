@@ -199,6 +199,7 @@ def main() -> None:
         return
 
     rows, platform, device_kind = [], None, None
+    consecutive_timeouts = 0
     for bs in [int(b) for b in args.batches.split(",")]:
         variants = ["jit", "spmd", "spmd_lazy", "spmd_scan8", "spmd_scan32",
                     "spmd_lazy_scan32"]
@@ -225,6 +226,20 @@ def main() -> None:
                 r, (platform, device_kind))
             rows.append(r)
             print(json.dumps(r), file=sys.stderr, flush=True)
+            # a wedged tunnel costs one point-timeout per point; two dead
+            # points in a row means the attach is gone — stop burning the
+            # window and let later session phases (or the re-arm) retry
+            if "timeout" in str(r.get("error", "")):
+                consecutive_timeouts += 1
+                if consecutive_timeouts >= 2:
+                    print("aborting sweep: 2 consecutive point timeouts "
+                          "(attach wedged)", file=sys.stderr)
+                    break
+            else:
+                consecutive_timeouts = 0
+        else:
+            continue
+        break
 
     out = {"platform": platform, "device_kind": device_kind,
            "model": {"V": V, "F": F, "K": K, "deep": DEEP},
