@@ -26,14 +26,13 @@ echo "== single-chip bench (BENCH_TPU.json; per-variant subprocess isolation) ==
 JAX_PLATFORMS=axon timeout 2400 python bench.py || status=1
 
 echo "== Criteo-Kaggle-scale convergence on device (45M records/epoch) =="
-# tuned optimizer: the round-4 sweep winner (docs/convergence_opt_sweep.json
-# cosine_lr2x_emb4 — 0.92 vs base 0.80 at 1M records); schedule horizon
-# rescales to this run's steps inside the harness
+# FLAT Adam: the batch-1024 tuned sweep winner does NOT transfer to large
+# batches (both tuned 45M CPU runs trail flat from epoch 0 —
+# docs/BENCH_CONVERGENCE_DEVICE.json, CONVERGENCE.md §3); flat 5e-4 is the
+# measured best at batch >=8192
 JAX_PLATFORMS=axon timeout 2400 \
     python benchmarks/convergence_device.py --records-per-epoch 45000000 \
-    --epochs 4 --batch 16384 \
-    --opt '{"learning_rate": 0.001, "lr_schedule": "cosine", "lr_end_fraction": 0.05, "embedding_lr_multiplier": 4.0}' \
-    --persist || status=1
+    --epochs 4 --batch 16384 --persist || status=1
 
 echo "== online-scoring latency/QPS over the exported servable =="
 JAX_PLATFORMS=axon timeout 1200 \
