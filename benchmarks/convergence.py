@@ -937,10 +937,14 @@ def write_md(out_dir: str) -> None:
                 f"{ceiling:.5f} Bayes ceiling — final gap {gap:.4f}"
                 f"{opt_note}.  Optimizer-variant runs in the artifact: "
                 f"{cmp_note}.  NOTE the batch-1024 tuned configuration of "
-                "§1 does NOT transfer to this study's batch 8192 — both "
-                "tuned 45M runs trail flat Adam from epoch 0 (hot table lr "
-                "hurts at 8x the batch), an honest negative result the "
-                "artifact preserves.  Earlier runs (2M-scale ramp, a "
+                "§1 does NOT transfer to this study's batch 8192: "
+                "dense+tuned trails a SAME-SEED flat epoch by ~0.012 AUC "
+                "(outside seed noise — 4x table lr hurts at 8x the batch), "
+                "while lazy+tuned lands within seed noise of flat (the "
+                "best flat run predates a round-3 init-seed change, so its "
+                "+0.0015 final margin over lazy+tuned is not significant). "
+                "An honest mixed result the artifact preserves.  "
+                "Earlier runs (2M-scale ramp, a "
                 "3-seed matched set with early-training spread 0.0097 — "
                 "the seed yardstick at that scale; §1's converged "
                 "yardstick is 0.0007) live in the `runs` history.  A "
