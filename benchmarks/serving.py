@@ -65,7 +65,12 @@ def main() -> None:
     platform, device_kind = bu.backend_platform()
 
     from deepfm_tpu.serve.export import load_servable
-    from deepfm_tpu.serve.server import BatchingScorer, Scorer, make_handler
+    from deepfm_tpu.serve.server import (
+        BatchingScorer,
+        Scorer,
+        ScoringHTTPServer,
+        make_handler,
+    )
 
     rows = []
     rng = np.random.default_rng(0)
@@ -95,9 +100,8 @@ def main() -> None:
         # full HTTP round trip (TF Serving REST shape), single connection
         import http.client
         import threading
-        from http.server import ThreadingHTTPServer
 
-        srv = ThreadingHTTPServer(
+        srv = ScoringHTTPServer(
             # the product handler wraps the scorer in the micro-batching
             # front (serve_forever does the same): concurrent requests
             # coalesce into shared dispatches

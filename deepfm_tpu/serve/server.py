@@ -316,6 +316,17 @@ def make_retrieval_handler(scorer: RetrievalScorer, model_name: str):
     return Handler
 
 
+class ScoringHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with a serving-appropriate listen backlog.
+
+    The stdlib default (request_queue_size=5) drops SYNs under a modest
+    connection burst — 16 simultaneous clients saw ~1s TCP-retransmit
+    stalls (p95 1033 ms on an idle host, docs/BENCH_SERVING.json) before
+    this override."""
+
+    request_queue_size = 128
+
+
 def _send_json(self, code: int, payload: dict) -> None:
     body = json.dumps(payload).encode()
     self.send_response(code)
@@ -409,7 +420,7 @@ def serve_forever(
         scorer = BatchingScorer(Scorer(predict, cfg.model.field_size, batch_size))
         handler = make_handler(scorer, model_name)
         endpoint = "predict"
-    httpd = ThreadingHTTPServer((host, port), handler)
+    httpd = ScoringHTTPServer((host, port), handler)
     if ready is not None:
         ready.port = httpd.server_address[1]  # type: ignore[attr-defined]
         ready.set()
