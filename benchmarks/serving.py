@@ -142,6 +142,38 @@ def main() -> None:
                 })
                 print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
 
+            # binary predict (the gRPC-role analog) at the LARGEST requested
+            # client batch — the regime where JSON encode/decode dominates
+            for cb in (max(int(x) for x in args.client_batches.split(",")),):
+                ids, vals = batch(cb)
+                body = (np.asarray([cb, F], "<u4").tobytes()
+                        + np.ascontiguousarray(ids).astype(
+                              "<i8", copy=False).tobytes()
+                        + np.ascontiguousarray(vals).astype(
+                              "<f4", copy=False).tobytes())
+                conn = http.client.HTTPConnection("127.0.0.1", port)
+                n_req = max(10, args.requests // 4)
+                conn.request("POST", "/v1/models/deepfm:predict_binary",
+                             body,
+                             {"Content-Type": "application/octet-stream"})
+                assert conn.getresponse().read()
+                t0 = time.perf_counter()
+                for _ in range(n_req):
+                    conn.request(
+                        "POST", "/v1/models/deepfm:predict_binary", body,
+                        {"Content-Type": "application/octet-stream"})
+                    r = conn.getresponse()
+                    payload = r.read()
+                    assert r.status == 200, payload[:200]
+                dt = time.perf_counter() - t0
+                conn.close()
+                rows.append({
+                    "layer": "http_binary", "client_batch": cb,
+                    "p50_ms_est": round(1e3 * dt / n_req, 3),
+                    "rows_per_sec": round(n_req * cb / dt, 1),
+                })
+                print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
+
             # concurrent batch-1 clients: the micro-batching front's regime
             # (round-3 finding: serialized per-request dispatches cost 12x
             # at b=1; coalescing shares dispatches across clients)

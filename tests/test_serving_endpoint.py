@@ -159,6 +159,32 @@ def test_rest_endpoint_tf_serving_shape(servable_dir):
     with urllib.request.urlopen(base, timeout=30) as r:
         assert r.status == 200
 
+    # binary predict: u32 n, u32 f, int64 ids, f32 vals -> f32 probs;
+    # same probabilities as the JSON endpoint
+    body = (
+        np.asarray([5, FIELD], "<u4").tobytes()
+        + ids.astype("<i8", copy=False).tobytes()
+        + vals.astype("<f4", copy=False).tobytes()
+    )
+    breq = urllib.request.Request(
+        f"{base}:predict_binary", data=body,
+        headers={"Content-Type": "application/octet-stream"},
+    )
+    with urllib.request.urlopen(breq, timeout=60) as r:
+        bpreds = np.frombuffer(r.read(), "<f4")
+    np.testing.assert_allclose(bpreds, preds, rtol=1e-5)
+
+    # truncated binary body -> 400, server stays up
+    bbad = urllib.request.Request(
+        f"{base}:predict_binary", data=body[:20],
+        headers={"Content-Type": "application/octet-stream"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(bbad, timeout=30)
+    assert ei.value.code == 400
+    with urllib.request.urlopen(base, timeout=30) as r:
+        assert r.status == 200
+
 
 @pytest.fixture(scope="module")
 def retrieval_servable_dir(tmp_path_factory):
