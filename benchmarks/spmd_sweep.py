@@ -192,6 +192,10 @@ def main() -> None:
     p.add_argument("--persist", action="store_true")
     p.add_argument("--point", default=None)
     p.add_argument("--point-timeout", type=int, default=600)
+    p.add_argument("--variants", default=None,
+                   help="comma list overriding the default variant set "
+                        "(degraded-window micro-session runs just "
+                        "spmd_scan32,jit)")
     args = p.parse_args()
 
     if args.point:
@@ -200,13 +204,22 @@ def main() -> None:
 
     rows, platform, device_kind = [], None, None
     consecutive_timeouts = 0
+    known = {"jit", "spmd", "spmd_lazy", "spmd_scan8", "spmd_scan32",
+             "spmd_scan128", "spmd_lazy_scan8", "spmd_lazy_scan32",
+             "spmd_lazy_scan128"}
     for bs in [int(b) for b in args.batches.split(",")]:
-        variants = ["jit", "spmd", "spmd_lazy", "spmd_scan8", "spmd_scan32",
-                    "spmd_lazy_scan32"]
-        # scan128's single stacked batch stays under the staging budget only
-        # at the reference batch size
-        if bs * 128 <= 2 * MAX_STAGED_EXAMPLES:
-            variants.append("spmd_scan128")
+        if args.variants:
+            variants = [v.strip() for v in args.variants.split(",")]
+            bad = [v for v in variants if v not in known]
+            if bad:
+                p.error(f"unknown variants {bad}; known: {sorted(known)}")
+        else:
+            variants = ["jit", "spmd", "spmd_lazy", "spmd_scan8",
+                        "spmd_scan32", "spmd_lazy_scan32"]
+            # scan128's single stacked batch stays under the staging budget
+            # only at the reference batch size
+            if bs * 128 <= 2 * MAX_STAGED_EXAMPLES:
+                variants.append("spmd_scan128")
         for variant in variants:
             # scans amortize per-dispatch cost; fewer dispatches suffice and
             # each one is K steps of real work

@@ -1,0 +1,34 @@
+#!/bin/bash
+# Degraded-window micro-session (VERDICT r04 next-step #1): a short capture
+# (~3 min healthy, <=18 min worst-case fully-wedged) that fires on ANY
+# successful tunnel attach — even when the full compile probe wedged — so a
+# brief or flaky window still banks the two rows the perf story needs most:
+#
+#   1. transfer.py          (frames every e2e number: rig vs framework)
+#   2. spmd_scan32 @ 8192   (the PRODUCT path with scan fusion — the row
+#                            that answers the 9.6x spmd-vs-jit gap)
+#   3. jit @ 8192           (the comparator on the SAME window)
+#
+# Every point is subprocess-isolated (tunnel cross-contamination,
+# docs/TPU_REPORT.md) with tight per-point timeouts: a wedged compile
+# service costs ~2.5 min here, not a full session's hours.  All persist
+# paths keep {latest, runs} history and never demote TPU data, so a later
+# full session simply refreshes these artifacts.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+status=0
+
+# timeouts budget for per-process attach latency (up to ~180s on this rig,
+# docs/TPU_WATCHER_LOG.jsonl) on TOP of the measurement itself — each point
+# is a fresh process that re-attaches from scratch
+echo "== micro: host<->device transfer (1 size, 2 reps) =="
+JAX_PLATFORMS=axon timeout 300 \
+    python benchmarks/transfer.py --sizes-mb 8 --reps 2 --persist || status=1
+
+echo "== micro: product path spmd_scan32 + jit comparator @ batch 8192 =="
+JAX_PLATFORMS=axon timeout 800 \
+    python benchmarks/spmd_sweep.py --batches 8192 \
+    --variants spmd_scan32,jit --dispatches 20 --sync-reps 5 \
+    --point-timeout 360 --persist || status=1
+
+exit $status
