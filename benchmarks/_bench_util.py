@@ -108,7 +108,9 @@ def rescale_schedule(opt: dict, steps: int) -> dict:
         return opt
     out = dict(opt)
     out["decay_steps"] = steps
-    out["warmup_steps"] = max(100, steps // 20)
+    # clamp below the horizon: for tiny benchmark horizons (steps <= 100)
+    # warmup==decay would make build_lr_schedule raise
+    out["warmup_steps"] = min(max(100, steps // 20), max(steps - 1, 0))
     return out
 
 

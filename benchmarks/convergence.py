@@ -298,6 +298,7 @@ def run_matched_steps(
     train_ds, eval_ds, *, variant: str, batch_size: int, seed: int,
     eval_every_steps: int, train_probe_rows: int = 200_000,
     opt_overrides: dict | None = None, epochs: int = 1,
+    model_overrides: dict | None = None,
 ):
     """``epochs`` passes over ``train_ds`` at matched step count for every
     variant (dense / lazy / dp8 / dp4_mp2), identical batch order (shuffle
@@ -313,6 +314,10 @@ def run_matched_steps(
     )
     if opt_overrides:
         cfg = cfg.with_overrides(optimizer=opt_overrides)
+    if model_overrides:
+        # capacity-ablation rows (verdict r04 #5): same data/steps/recipe,
+        # different model capacity (K, deep tower)
+        cfg = cfg.with_overrides(model=model_overrides)
     if spmd:
         from deepfm_tpu.core.config import MeshConfig
         from deepfm_tpu.parallel import (
@@ -482,16 +487,18 @@ def run_synthetic(args) -> None:
             except Exception:
                 pass
 
-    def run_row(key, variant, seed, opt=None):
+    def run_row(key, variant, seed, opt=None, model=None):
         if key in results:
             return
         curve, secs = run_matched_steps(
             train_ds, eval_ds, variant=variant, seed=seed,
-            opt_overrides=opt, **kw
+            opt_overrides=opt, model_overrides=model, **kw
         )
         row = {"curve": curve, "seconds": secs}
         if opt:
             row["opt"] = opt
+        if model:
+            row["model"] = model
         results[key] = row
 
     for s in range(args.seeds):
@@ -504,6 +511,22 @@ def run_synthetic(args) -> None:
         for s in range(args.seeds):
             run_row(f"dense_tuned_seed{s}", "dense", s, opt=tuned)
         run_row("lazy_tuned", "lazy", 0, opt=tuned)
+    if args.capacity:
+        # verdict r04 #5: is the remaining lazy_tuned->Bayes gap capacity-
+        # or optimizer-bound?  Same recipe (lazy_tuned), bigger model.  The
+        # teacher is rank-8 over K=32-embeddable structure, so if capacity
+        # is the binding constraint these rows move toward the ceiling; if
+        # they sit inside the lazy_tuned band, it's optimization.
+        # baseline band at matched seeds ("lazy_tuned" above is seed 0)
+        for s in range(1, args.seeds):
+            run_row(f"lazy_tuned_seed{s}", "lazy", s, opt=tuned)
+        for name, model in (
+            ("K64", {"embedding_size": 64}),
+            ("deep256", {"deep_layers": (256, 128, 64)}),
+        ):
+            for s in range(args.seeds):
+                run_row(f"lazy_tuned_{name}_seed{s}", "lazy", s,
+                        opt=tuned, model=model)
 
     payload = {"meta": meta, "results": results}
     os.makedirs(args.out, exist_ok=True)
@@ -619,6 +642,10 @@ def main() -> None:
                     help="synthetic mode: keep committed rows from "
                          "convergence_synthetic.json (same generator/"
                          "horizon) and run only missing variants")
+    ap.add_argument("--capacity", action="store_true",
+                    help="synthetic mode: add capacity-ablation rows "
+                         "(K=64, deep 256/128/64) x seeds on the lazy_tuned "
+                         "recipe; requires --tuned")
     ap.add_argument("--records", type=int, default=5_000_000)
     ap.add_argument("--seeds", type=int, default=3)
     ap.add_argument("--eval-every-steps", type=int, default=1200)
@@ -631,6 +658,9 @@ def main() -> None:
     if args.tuned and args.dataset != "synthetic":
         ap.error("--tuned only applies to --dataset synthetic (it adds "
                  "dense_tuned/lazy_tuned rows to the matched-steps study)")
+    if args.capacity and not (args.tuned and args.dataset == "synthetic"):
+        ap.error("--capacity requires --dataset synthetic with --tuned "
+                 "(the ablation holds the tuned recipe fixed)")
     if args.dataset == "sweep":
         if args.batch_size == 512:
             args.batch_size = 1024

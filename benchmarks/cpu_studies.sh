@@ -1,0 +1,21 @@
+#!/bin/bash
+# Round-5 background CPU studies, chained (1 core: run sequentially, niced
+# so foreground test runs preempt them).
+#   1. capacity ablation on the 0.034 lazy_tuned->Bayes gap (VERDICT r04 #5)
+#   2. batch-8192 optimizer recipe sweep            (VERDICT r04 #8)
+# Always JAX_PLATFORMS=cpu: without it the axon PJRT plugin hangs jax init
+# for minutes whenever the tunnel is down.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+TUNED='{"learning_rate": 0.001, "lr_schedule": "cosine", "lr_end_fraction": 0.05, "embedding_lr_multiplier": 4.0}'
+
+echo "== capacity ablation (K=64 / deep 256-128-64 x 3 seeds, lazy_tuned) =="
+nice -n 10 python benchmarks/convergence.py --dataset synthetic \
+    --records 5000000 --seeds 3 --reuse --capacity \
+    --tuned "$TUNED" || echo "capacity ablation FAILED"
+
+echo "== batch-8192 optimizer sweep (probe then 3-seed winner) =="
+nice -n 10 python benchmarks/opt8192.py || echo "opt8192 FAILED"
+
+echo "cpu_studies: all done"
