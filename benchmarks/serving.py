@@ -56,6 +56,9 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--requests", type=int, default=200)
     p.add_argument("--client-batches", default="1,64,1024")
+    p.add_argument("--pool-workers", type=int, default=2,
+                   help="also sweep the SO_REUSEPORT pool with this many "
+                        "worker processes (0 disables)")
     p.add_argument("--persist", action="store_true")
     args = p.parse_args()
 
@@ -176,53 +179,41 @@ def main() -> None:
 
             # concurrent batch-1 clients: the micro-batching front's regime
             # (round-3 finding: serialized per-request dispatches cost 12x
-            # at b=1; coalescing shares dispatches across clients)
-            for n_clients in (4, 16):
-                ids, vals = batch(1)
-                body = json.dumps({
-                    "instances": [{"feat_ids": ids[0].tolist(),
-                                   "feat_vals": vals[0].tolist()}]
-                })
-                per_client = max(5, args.requests // (4 * n_clients))
-                lat: list[float] = []
-                lat_lock = threading.Lock()
-
-                def client():
-                    conn = http.client.HTTPConnection("127.0.0.1", port)
-                    mine = []
-                    for _ in range(per_client):
-                        t1 = time.perf_counter()
-                        conn.request(
-                            "POST", "/v1/models/deepfm:predict", body,
-                            {"Content-Type": "application/json"})
-                        r = conn.getresponse()
-                        payload = r.read()
-                        assert r.status == 200, payload[:200]
-                        mine.append(time.perf_counter() - t1)
-                    conn.close()
-                    with lat_lock:
-                        lat.extend(mine)
-
-                threads = [threading.Thread(target=client)
-                           for _ in range(n_clients)]
-                t0 = time.perf_counter()
-                for th in threads:
-                    th.start()
-                for th in threads:
-                    th.join()
-                dt = time.perf_counter() - t0
-                lat.sort()
-                rows.append({
-                    "layer": "http_concurrent", "client_batch": 1,
-                    "clients": n_clients,
-                    "p50_ms": round(1e3 * lat[len(lat) // 2], 3),
-                    "p95_ms": round(1e3 * lat[int(len(lat) * 0.95)], 3),
-                    "rows_per_sec": round(n_clients * per_client / dt, 1),
-                })
-                print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
+            # at b=1; coalescing shares dispatches across clients).  JSON at
+            # the original client counts, binary at 16/64 (verdict r04 #4).
+            ids1, vals1 = batch(1)
+            json_body = json.dumps({
+                "instances": [{"feat_ids": ids1[0].tolist(),
+                               "feat_vals": vals1[0].tolist()}]
+            })
+            bin_body = (np.asarray([1, F], "<u4").tobytes()
+                        + np.ascontiguousarray(ids1).astype(
+                              "<i8", copy=False).tobytes()
+                        + np.ascontiguousarray(vals1).astype(
+                              "<f4", copy=False).tobytes())
+            for layer, path, body_b, ctype, counts in (
+                ("http_concurrent", "/v1/models/deepfm:predict",
+                 json_body, "application/json", (4, 16)),
+                ("http_concurrent_binary",
+                 "/v1/models/deepfm:predict_binary",
+                 bin_body, "application/octet-stream", (16, 64)),
+            ):
+                for n_clients in counts:
+                    rows.append(_concurrent_row(
+                        port, layer=layer, path=path, body=body_b,
+                        content_type=ctype, n_clients=n_clients,
+                        per_client=max(5, args.requests // (4 * n_clients)),
+                    ))
+                    print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
         finally:
             srv.shutdown()
 
+        # SO_REUSEPORT pool (serve_pool): same concurrent binary sweep
+        # against N worker processes sharing the port.  On a 1-core host
+        # this measures the overhead floor, not a speedup — the pool's
+        # value is per-core scaling; the row records host cores for that.
+        if args.pool_workers > 0:
+            rows.extend(_pool_rows(servable, args))
     out = {"platform": platform, "device_kind": device_kind,
            "model": {"V": V, "F": F},
            "requests": args.requests,
@@ -234,6 +225,133 @@ def main() -> None:
                 os.path.abspath(__file__))), "docs", "BENCH_SERVING.json"),
             out, ok=len(rows), platform=platform,
         )
+
+
+def _concurrent_row(port: int, *, layer: str, path: str, body,
+                    content_type: str, n_clients: int,
+                    per_client: int) -> dict:
+    import http.client
+    import threading
+
+    lat: list[float] = []
+    lat_lock = threading.Lock()
+    errors: list[str] = []
+
+    def client():
+        conn = http.client.HTTPConnection("127.0.0.1", port)
+        mine = []
+        try:
+            for _ in range(per_client):
+                t1 = time.perf_counter()
+                conn.request("POST", path, body,
+                             {"Content-Type": content_type})
+                r = conn.getresponse()
+                payload = r.read()
+                if r.status != 200:
+                    errors.append(f"{r.status}: {payload[:120]!r}")
+                    return
+                mine.append(time.perf_counter() - t1)
+        finally:
+            conn.close()
+            with lat_lock:
+                lat.extend(mine)
+
+    threads = [threading.Thread(target=client) for _ in range(n_clients)]
+    t0 = time.perf_counter()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    dt = time.perf_counter() - t0
+    lat.sort()
+    row = {
+        "layer": layer, "client_batch": 1, "clients": n_clients,
+        "p50_ms": round(1e3 * lat[len(lat) // 2], 3) if lat else None,
+        "p95_ms": round(1e3 * lat[int(len(lat) * 0.95)], 3) if lat else None,
+        "rows_per_sec": round(len(lat) / dt, 1),
+    }
+    if errors:
+        row["errors"] = errors[:3]
+    return row
+
+
+def _pool_rows(servable: str, args) -> list[dict]:
+    import re
+    import signal
+    import subprocess
+
+    from deepfm_tpu.core.platform import host_cpu_count
+
+    # pool workers always run on CPU: N processes cannot share one TPU
+    # chip (the TF-Serving analog is a CPU-host worker pool anyway); the
+    # row is labeled pool_platform so TPU-session artifacts stay honest
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "deepfm_tpu.serve.server",
+         "--servable", servable, "--port", "0",
+         "--workers", str(args.pool_workers)],
+        stderr=subprocess.PIPE, text=True, env=env,
+    )
+    rows: list[dict] = []
+    try:
+        port = None
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            line = proc.stderr.readline()
+            if not line:  # EOF: dead child would otherwise busy-spin here
+                if proc.poll() is not None:
+                    break
+                time.sleep(0.2)
+                continue
+            m = re.search(r"serving pool: \d+ workers on [\d.]+:(\d+)", line)
+            if m:
+                port = int(m.group(1))
+                break
+        if not port:
+            return [{"layer": "http_pool_binary",
+                     "error": "pool did not start"}]
+        rng = np.random.default_rng(1)
+        ids = rng.integers(0, V, (1, F))
+        vals = rng.random((1, F), dtype=np.float32)
+        body = (np.asarray([1, F], "<u4").tobytes()
+                + np.ascontiguousarray(ids).astype(
+                      "<i8", copy=False).tobytes()
+                + np.ascontiguousarray(vals).astype(
+                      "<f4", copy=False).tobytes())
+        # wait for a worker to accept + compile
+        import http.client
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port)
+                conn.request("POST", "/v1/models/deepfm:predict_binary",
+                             body,
+                             {"Content-Type": "application/octet-stream"})
+                if conn.getresponse().read() is not None:
+                    conn.close()
+                    break
+            except (ConnectionError, OSError):
+                time.sleep(0.5)
+        for n_clients in (16, 64):
+            row = _concurrent_row(
+                port, layer="http_pool_binary",
+                path="/v1/models/deepfm:predict_binary", body=body,
+                content_type="application/octet-stream",
+                n_clients=n_clients,
+                per_client=max(5, args.requests // (4 * n_clients)),
+            )
+            row["workers"] = args.pool_workers
+            row["host_cpus"] = host_cpu_count()
+            row["pool_platform"] = "cpu"
+            rows.append(row)
+            print(json.dumps(row), file=sys.stderr, flush=True)
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    return rows
 
 
 if __name__ == "__main__":

@@ -1,2 +1,3 @@
 from .ckpt import Checkpointer, maybe_clear  # noqa: F401
+from .remote import RemoteCheckpointer, make_checkpointer  # noqa: F401
 from .reshard import restore_resharded  # noqa: F401

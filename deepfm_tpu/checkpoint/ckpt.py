@@ -116,8 +116,15 @@ class Checkpointer:
 
 
 def maybe_clear(directory: str, enabled: bool) -> None:
-    """``clear_existing_model`` capability (hvd:66-68, hvd:372-378)."""
-    if enabled and os.path.isdir(directory):
+    """``clear_existing_model`` capability (hvd:66-68, hvd:372-378); remote
+    model_dirs clear the object prefix instead."""
+    if not enabled:
+        return
+    from ..data.object_store import get_store, is_url
+
+    if is_url(directory):
+        get_store().delete_prefix(directory.rstrip("/") + "/")
+    elif os.path.isdir(directory):
         import shutil
 
         shutil.rmtree(directory)

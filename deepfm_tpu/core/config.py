@@ -215,6 +215,9 @@ class RunConfig:
     serve_port: int = 8501            # task_type=serve bind port
     serve_host: str = "127.0.0.1"     # bind address (0.0.0.0 for remote clients)
     serve_item_corpus: str = ""       # two-tower: JSONL corpus for :retrieve
+    serve_workers: int = 1            # >1: SO_REUSEPORT process pool (the
+                                      # TF-Serving worker-pool analog,
+                                      # serve/server.py serve_pool)
     # in-process crash retries with resume-from-checkpoint (the spot-retry
     # analog of use_spot_instances/max_wait, both notebooks cell 4)
     max_restarts: int = 0

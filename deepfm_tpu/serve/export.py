@@ -143,8 +143,27 @@ def load_retrieval_servable(
 def write_predictions(
     probs: Iterator[np.ndarray] | Iterator[float], path: str | os.PathLike
 ) -> int:
-    """The ``infer``-task output: one probability per line (ps:526-533)."""
+    """The ``infer``-task output: one probability per line (ps:526-533).
+    An object-URL path uploads the finished file (spooled via tempfile so
+    memory stays O(spool buffer), matching the local streaming write)."""
+    from ..data.object_store import get_store, is_url
+
     count = 0
+    if is_url(path):
+        import tempfile
+
+        with tempfile.SpooledTemporaryFile(
+            max_size=1 << 24, mode="w+b"
+        ) as f:
+            for p in probs:
+                arr = np.atleast_1d(np.asarray(p))
+                for v in arr:
+                    f.write(f"{float(v):.6f}\n".encode())
+                    count += 1
+            length = f.tell()
+            f.seek(0)
+            get_store().put_stream(str(path), f, length)
+        return count
     with open(path, "w") as f:
         for p in probs:
             arr = np.atleast_1d(np.asarray(p))

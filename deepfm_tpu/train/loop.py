@@ -20,7 +20,7 @@ from typing import Iterator
 import jax
 import numpy as np
 
-from ..checkpoint import Checkpointer, maybe_clear, restore_resharded
+from ..checkpoint import Checkpointer, make_checkpointer, maybe_clear, restore_resharded
 from ..core.config import Config
 from ..launch.preemption import PreemptedError, PreemptionGuard
 from ..data.pipeline import (
@@ -291,7 +291,7 @@ def _run_train_guarded(cfg: Config, guard: PreemptionGuard) -> TrainState:
     log = MetricLogger(log_steps=cfg.run.log_steps)
     # checkpoint cadence lives HERE (the step % N gate below) — Checkpointer
     # itself has no interval policy, so there is exactly one mechanism
-    ckpt = Checkpointer(cfg.run.model_dir, max_to_keep=cfg.run.keep_checkpoints)
+    ckpt = make_checkpointer(cfg.run.model_dir, max_to_keep=cfg.run.keep_checkpoints)
     state = create_spmd_state(ctx)
     if ckpt.latest_step() is not None:
         state = restore_latest(ckpt, ctx, state, log)
@@ -412,7 +412,7 @@ def run_infer(cfg: Config, *, output_path: str | None = None) -> str:
             "DEEPFM_COORDINATOR (the trained model_dir restores fine on one "
             "process — shardings adapt to the local mesh)"
         )
-    ckpt = Checkpointer(cfg.run.model_dir)
+    ckpt = make_checkpointer(cfg.run.model_dir)
     state = restore_latest(ckpt, ctx, create_spmd_state(ctx))
     predict_step = make_spmd_predict_step(ctx)
     # fallback chain, not a union: te*/test* first (the reference's infer
@@ -449,7 +449,7 @@ def run_infer(cfg: Config, *, output_path: str | None = None) -> str:
 def run_export(cfg: Config) -> str:
     """EXPORT task: restore latest checkpoint -> servable (ps:535-551)."""
     ctx = setup(cfg)
-    ckpt = Checkpointer(cfg.run.model_dir)
+    ckpt = make_checkpointer(cfg.run.model_dir)
     state = restore_latest(ckpt, ctx, create_spmd_state(ctx))
     path = export_servable(ctx.cfg, state, cfg.run.servable_model_dir)
     ckpt.close()
@@ -513,7 +513,7 @@ def _run_retrieval_train_guarded(
     ctx = _retrieval_setup(cfg)
     maybe_clear(cfg.run.model_dir, cfg.run.clear_existing_model)
     log = MetricLogger(log_steps=cfg.run.log_steps)
-    ckpt = Checkpointer(cfg.run.model_dir, max_to_keep=cfg.run.keep_checkpoints)
+    ckpt = make_checkpointer(cfg.run.model_dir, max_to_keep=cfg.run.keep_checkpoints)
     state = create_retrieval_spmd_state(ctx)
     if ckpt.latest_step() is not None:
         state = ckpt.restore(state)
@@ -611,14 +611,14 @@ def run_retrieval_task(cfg: Config):
         return run_retrieval_train(cfg)
     if task == "eval":
         ctx = _retrieval_setup(cfg)
-        ckpt = Checkpointer(cfg.run.model_dir)
+        ckpt = make_checkpointer(cfg.run.model_dir)
         state = ckpt.restore(create_retrieval_spmd_state(ctx))
         result = run_retrieval_eval(cfg, ctx, state, MetricLogger())
         ckpt.close()
         return result
     if task == "export":
         ctx = _retrieval_setup(cfg)
-        ckpt = Checkpointer(cfg.run.model_dir)
+        ckpt = make_checkpointer(cfg.run.model_dir)
         state = ckpt.restore(create_retrieval_spmd_state(ctx))
         path = export_servable(ctx.cfg, state, cfg.run.servable_model_dir)
         ckpt.close()
@@ -635,8 +635,17 @@ def run_task(cfg: Config):
     TF-Serving step of the reference's workflow, serve/server.py)."""
     task = cfg.run.task_type
     if task == "serve":
-        from ..serve.server import serve_forever
+        from ..serve.server import serve_forever, serve_pool
 
+        if cfg.run.serve_workers > 1:
+            serve_pool(
+                cfg.run.servable_model_dir,
+                workers=cfg.run.serve_workers,
+                port=cfg.run.serve_port,
+                host=cfg.run.serve_host,
+                item_corpus=cfg.run.serve_item_corpus or None,
+            )
+            return None
         serve_forever(
             cfg.run.servable_model_dir,
             port=cfg.run.serve_port,
@@ -650,7 +659,7 @@ def run_task(cfg: Config):
         return run_train(cfg)
     if task == "eval":
         ctx = setup(cfg)
-        ckpt = Checkpointer(cfg.run.model_dir)
+        ckpt = make_checkpointer(cfg.run.model_dir)
         state = restore_latest(ckpt, ctx, create_spmd_state(ctx))
         result = run_eval(cfg, ctx, state, MetricLogger())
         ckpt.close()

@@ -1,0 +1,181 @@
+"""Directory-backed dev object store speaking the S3 wire subset.
+
+The stand-in for S3/GCS in tests and local development (the reference's
+equivalent surface is the real S3 SageMaker mounts, README.md:63-75): serves
+an on-disk root over HTTP with exactly the verbs
+``deepfm_tpu.data.object_store.HttpObjectStore`` uses —
+
+    GET    /bucket/key            object bytes (supports ``Range: bytes=N-``)
+    GET    /bucket?list-type=2    ListObjectsV2 XML (+ continuation token)
+    PUT    /bucket/key            write object (parents auto-created)
+    HEAD   /bucket/key            size probe
+    DELETE /bucket/key            remove object
+
+Buckets are first-level directories under the served root.  Keys map to
+file paths (guarded against traversal).  Pagination truncates at
+``--max-keys`` (default 1000, settable low in tests to exercise the
+continuation path).
+
+Run standalone:  python -m deepfm_tpu.utils.dev_object_store --root DIR
+In tests:        serve(root, max_keys=...) -> (server, base_url)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from xml.sax.saxutils import escape
+
+
+def _make_handler(root: str, max_keys: int):
+    root = os.path.abspath(root)
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):  # quiet
+            pass
+
+        # -- helpers -------------------------------------------------------
+        def _path_for(self, raw: str) -> str | None:
+            """Decoded fs path for /bucket/key, or None on traversal."""
+            rel = urllib.parse.unquote(raw).lstrip("/")
+            path = os.path.abspath(os.path.join(root, rel))
+            if path != root and not path.startswith(root + os.sep):
+                return None
+            return path
+
+        def _send(self, code: int, body: bytes = b"",
+                  ctype: str = "application/octet-stream") -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            if self.command != "HEAD":
+                self.wfile.write(body)
+
+        # -- verbs ---------------------------------------------------------
+        def do_GET(self) -> None:
+            parsed = urllib.parse.urlsplit(self.path)
+            q = urllib.parse.parse_qs(parsed.query)
+            if q.get("list-type") == ["2"]:
+                return self._do_list(parsed, q)
+            path = self._path_for(parsed.path)
+            if path is None or not os.path.isfile(path):
+                return self._send(404, b"no such key", "text/plain")
+            with open(path, "rb") as f:
+                data = f.read()
+            rng = self.headers.get("Range")
+            if rng and rng.startswith("bytes="):
+                spec = rng[len("bytes="):]
+                start_s, _, end_s = spec.partition("-")
+                start = int(start_s) if start_s else 0
+                end = int(end_s) if end_s else len(data) - 1
+                part = data[start:end + 1]
+                self.send_response(206)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header(
+                    "Content-Range", f"bytes {start}-{end}/{len(data)}")
+                self.send_header("Content-Length", str(len(part)))
+                self.end_headers()
+                self.wfile.write(part)
+                return
+            self._send(200, data)
+
+        def _do_list(self, parsed, q) -> None:
+            bucket = parsed.path.strip("/")
+            bucket_dir = self._path_for("/" + bucket)
+            if bucket_dir is None or not os.path.isdir(bucket_dir):
+                return self._send(404, b"no such bucket", "text/plain")
+            prefix = q.get("prefix", [""])[0]
+            token = q.get("continuation-token", [""])[0]
+            keys = []
+            for r, _, files in os.walk(bucket_dir):
+                for name in files:
+                    rel = os.path.relpath(os.path.join(r, name), bucket_dir)
+                    key = rel.replace(os.sep, "/")
+                    if key.startswith(prefix):
+                        keys.append(key)
+            keys.sort()
+            if token:  # token = last key of the previous page
+                keys = [k for k in keys if k > token]
+            page, truncated = keys[:max_keys], len(keys) > max_keys
+            parts = [
+                "<?xml version='1.0' encoding='UTF-8'?>",
+                "<ListBucketResult>",
+                f"<Name>{escape(bucket)}</Name>",
+                f"<Prefix>{escape(prefix)}</Prefix>",
+                f"<KeyCount>{len(page)}</KeyCount>",
+                f"<IsTruncated>{'true' if truncated else 'false'}</IsTruncated>",
+            ]
+            if truncated and page:
+                parts.append(
+                    f"<NextContinuationToken>{escape(page[-1])}"
+                    "</NextContinuationToken>")
+            for k in page:
+                parts.append(f"<Contents><Key>{escape(k)}</Key></Contents>")
+            parts.append("</ListBucketResult>")
+            self._send(200, "".join(parts).encode(), "application/xml")
+
+        def do_HEAD(self) -> None:
+            path = self._path_for(urllib.parse.urlsplit(self.path).path)
+            if path is None or not os.path.isfile(path):
+                return self._send(404)
+            self.send_response(200)
+            self.send_header("Content-Length", str(os.path.getsize(path)))
+            self.end_headers()
+
+        def do_PUT(self) -> None:
+            path = self._path_for(urllib.parse.urlsplit(self.path).path)
+            if path is None:
+                return self._send(403, b"traversal", "text/plain")
+            length = int(self.headers.get("Content-Length", 0))
+            data = self.rfile.read(length)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".tmp_put"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)  # atomic publish, S3-like
+            self._send(200)
+
+        def do_DELETE(self) -> None:
+            path = self._path_for(urllib.parse.urlsplit(self.path).path)
+            if path is None or not os.path.isfile(path):
+                return self._send(404)
+            os.remove(path)
+            self._send(204)
+
+    return Handler
+
+
+def serve(root: str, *, host: str = "127.0.0.1", port: int = 0,
+          max_keys: int = 1000) -> tuple[ThreadingHTTPServer, str]:
+    """Start a daemon-thread server; returns (server, base_url).  Callers
+    own shutdown: ``server.shutdown(); server.server_close()``."""
+    server = ThreadingHTTPServer((host, port), _make_handler(root, max_keys))
+    server.daemon_threads = True
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, f"http://{host}:{server.server_address[1]}"
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=9000)
+    ap.add_argument("--max-keys", type=int, default=1000)
+    args = ap.parse_args()
+    server, url = serve(args.root, host=args.host, port=args.port,
+                        max_keys=args.max_keys)
+    print(f"dev object store on {url} serving {os.path.abspath(args.root)}")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

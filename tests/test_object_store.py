@@ -1,0 +1,308 @@
+"""Remote object-store data plane (VERDICT r04 #2).
+
+The reference's channels and model_dir live on S3 (ps nb cell 4
+``model_dir = s3://...``, README.md:63-75 S3 shard semantics); the platform
+does the transfers.  Here the framework owns the layer: these tests run the
+bundled dev store (``deepfm_tpu.utils.dev_object_store`` — the S3-wire-subset
+stand-in) and drive the full path: listing, streaming reads, the native-FIFO
+bridge, remote checkpointing with atomic publish + retention, and an
+end-to-end ``run_train`` whose training data AND model_dir are URLs.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deepfm_tpu.core.config import Config
+from deepfm_tpu.data import generate_synthetic_ctr
+from deepfm_tpu.data.object_store import (
+    HttpObjectStore,
+    ObjectStoreError,
+    is_url,
+    join_url,
+)
+from deepfm_tpu.utils.dev_object_store import serve
+
+FEATURE, FIELD = 300, 6
+
+
+@pytest.fixture()
+def store_env(tmp_path):
+    root = tmp_path / "store_root"
+    (root / "bucket").mkdir(parents=True)
+    server, base = serve(str(root), max_keys=3)
+    yield root, base, HttpObjectStore(timeout=10)
+    server.shutdown()
+    server.server_close()
+
+
+def test_url_predicates():
+    assert is_url("http://h/b/k") and is_url("https://h/b/k")
+    assert not is_url("/local/path") and not is_url("gs://nope")
+    assert join_url("http://h/b", "a", "c/d") == "http://h/b/a/c/d"
+
+
+def test_put_get_head_delete_range(store_env):
+    _, base, store = store_env
+    url = f"{base}/bucket/dir/obj.bin"
+    payload = bytes(range(256)) * 4
+    assert not store.exists(url)
+    store.put(url, payload)
+    assert store.exists(url)
+    assert store.size(url) == len(payload)
+    assert store.get(url) == payload
+    with store.open_read(url, offset=1000) as r:
+        assert r.read() == payload[1000:]
+    store.delete(url)
+    assert not store.exists(url)
+    with pytest.raises(ObjectStoreError):
+        store.get(url)
+
+
+def test_list_prefix_paginates(store_env):
+    _, base, store = store_env
+    # max_keys=3 in the fixture: 8 objects forces 3 pages through the
+    # continuation-token path
+    for i in range(8):
+        store.put(f"{base}/bucket/pfx/f{i:02d}", b"x")
+    store.put(f"{base}/bucket/other/f", b"x")
+    urls = store.list_prefix(f"{base}/bucket/pfx/")
+    assert urls == [f"{base}/bucket/pfx/f{i:02d}" for i in range(8)]
+
+
+def test_discover_files_remote_matches_local(store_env, tmp_path):
+    from deepfm_tpu.data.pipeline import discover_files
+
+    root, base, store = store_env
+    local = tmp_path / "local"
+    local.mkdir()
+    for name in ("tr-0.tfrecords", "tr-1.tfrecords", "va-0.tfrecords",
+                 "notes.txt"):
+        generate_synthetic_ctr(local / name, num_records=8,
+                               feature_size=FEATURE, field_size=FIELD, seed=1)
+        store.put(f"{base}/bucket/ds/{name}", (local / name).read_bytes())
+    remote = discover_files(f"{base}/bucket/ds", shuffle=False)
+    assert [u.rsplit("/", 1)[-1] for u in remote] == [
+        "tr-0.tfrecords", "tr-1.tfrecords"]
+    # seeded shuffle must be deterministic and identical to the local
+    # ordering semantics (multi-host enumeration contract)
+    r1 = discover_files(f"{base}/bucket/ds", shuffle=True, seed=3)
+    r2 = discover_files(f"{base}/bucket/ds", shuffle=True, seed=3)
+    assert r1 == r2
+
+
+def _upload_dataset(store, base, tmp_path, *, files=2, records=96):
+    local = tmp_path / "ds_local"
+    local.mkdir(exist_ok=True)
+    for i in range(files):
+        name = f"tr-{i}.tfrecords"
+        generate_synthetic_ctr(local / name, num_records=records,
+                               feature_size=FEATURE, field_size=FIELD, seed=i)
+        store.put(f"{base}/bucket/ds/{name}", (local / name).read_bytes())
+    return local
+
+
+def test_remote_batches_match_local_python_path(store_env, tmp_path,
+                                                monkeypatch):
+    """Streaming decode from URLs == local decode, via the pure-Python
+    reader (native path covered separately)."""
+    import deepfm_tpu.native as native
+    from deepfm_tpu.data.pipeline import InMemoryDataset
+
+    local = _upload_dataset(store_env[2], store_env[1], tmp_path)
+    monkeypatch.setattr(native, "available", lambda: False)
+    ds_local = InMemoryDataset.from_files(
+        sorted(str(p) for p in local.glob("tr-*.tfrecords")), FIELD)
+    ds_remote = InMemoryDataset.from_files(
+        [f"{store_env[1]}/bucket/ds/tr-0.tfrecords",
+         f"{store_env[1]}/bucket/ds/tr-1.tfrecords"], FIELD)
+    np.testing.assert_array_equal(ds_local.feat_ids, ds_remote.feat_ids)
+    np.testing.assert_array_equal(ds_local.feat_vals, ds_remote.feat_vals)
+    np.testing.assert_array_equal(ds_local.label, ds_remote.label)
+
+
+def test_remote_batches_match_local_native_fifo(store_env, tmp_path):
+    """The FIFO bridge feeds the C++ reader the same bytes HTTP delivered."""
+    import deepfm_tpu.native as native
+    from deepfm_tpu.data.pipeline import InMemoryDataset
+
+    if not native.available():
+        pytest.skip("native reader not built")
+    local = _upload_dataset(store_env[2], store_env[1], tmp_path)
+    ds_local = InMemoryDataset.from_files(
+        sorted(str(p) for p in local.glob("tr-*.tfrecords")), FIELD)
+    ds_remote = InMemoryDataset.from_files(
+        [f"{store_env[1]}/bucket/ds/tr-0.tfrecords",
+         f"{store_env[1]}/bucket/ds/tr-1.tfrecords"], FIELD)
+    np.testing.assert_array_equal(ds_local.feat_ids, ds_remote.feat_ids)
+    np.testing.assert_array_equal(ds_local.label, ds_remote.label)
+
+
+def test_remote_stream_failure_is_loud(store_env, tmp_path):
+    """A vanished object must raise, not truncate the epoch silently."""
+    from deepfm_tpu.data.pipeline import ctr_batches_from_sources
+
+    _upload_dataset(store_env[2], store_env[1], tmp_path, files=1)
+    missing = f"{store_env[1]}/bucket/ds/tr-9.tfrecords"
+    with pytest.raises(ObjectStoreError):
+        list(ctr_batches_from_sources(
+            [missing], batch_size=16, field_size=FIELD))
+
+
+def _train_cfg(data_dir, model_dir, num_epochs=2) -> Config:
+    return Config.from_dict({
+        "model": {
+            "feature_size": FEATURE, "field_size": FIELD,
+            "embedding_size": 4, "deep_layers": (8, 4),
+            "dropout_keep": (1.0, 1.0), "compute_dtype": "float32",
+        },
+        "data": {
+            "training_data_dir": str(data_dir),
+            "batch_size": 32, "num_epochs": num_epochs,
+        },
+        "mesh": {"data_parallel": 4, "model_parallel": 2},
+        "run": {
+            "model_dir": str(model_dir), "servable_model_dir": "",
+            "checkpoint_every_steps": 0, "log_steps": 1000,
+        },
+    })
+
+
+def test_remote_checkpointer_roundtrip(store_env, tmp_path):
+    from deepfm_tpu.checkpoint import make_checkpointer
+    from deepfm_tpu.parallel import build_mesh, create_spmd_state, make_context
+    from deepfm_tpu.core.config import MeshConfig
+
+    _, base, store = store_env
+    url = f"{base}/bucket/model_a"
+    cfg = _train_cfg("unused", url)
+    mesh = build_mesh(MeshConfig(data_parallel=4, model_parallel=2))
+    ctx = make_context(cfg, mesh)
+    state = create_spmd_state(ctx)
+
+    ck = make_checkpointer(url, max_to_keep=2,
+                           staging_dir=str(tmp_path / "stage_a"))
+    assert ck.latest_step() is None
+    import jax.numpy as jnp
+
+    for step in (1, 2, 3):
+        st = state._replace(step=jnp.asarray(step, state.step.dtype))
+        assert ck.save(st, block=True)
+    # retention mirrors max_to_keep=2, markers are the commit protocol
+    assert ck.all_steps() == [2, 3]
+    names = [u.rsplit("/", 1)[-1] for u in store.list_prefix(url + "/")]
+    assert "_COMMIT_3" in names and "_COMMIT_2" in names
+    assert "_COMMIT_1" not in names
+    ck.close()
+
+    # a FRESH staging dir (new host) must restore purely from the store
+    ck2 = make_checkpointer(url, staging_dir=str(tmp_path / "stage_b"))
+    assert ck2.latest_step() == 3
+    restored = ck2.restore(state)
+    assert int(restored.step) == 3
+    ck2.close()
+
+
+def test_run_train_remote_data_and_model_dir(store_env, tmp_path):
+    """End-to-end (verdict r04 #2 'done' bar): train FROM remote-scheme
+    URLs and checkpoint TO one, then resume from the remote checkpoint."""
+    from deepfm_tpu.checkpoint import make_checkpointer
+    from deepfm_tpu.parallel import build_mesh, create_spmd_state, make_context
+    from deepfm_tpu.core.config import MeshConfig
+    from deepfm_tpu.train.loop import run_train
+
+    _, base, store = store_env
+    _upload_dataset(store, base, tmp_path, files=2, records=96)
+    data_url = f"{base}/bucket/ds"
+    model_url = f"{base}/bucket/model_e2e"
+
+    cfg = _train_cfg(data_url, model_url, num_epochs=1)
+    state = run_train(cfg)
+    steps_one_epoch = int(state.step)
+    assert steps_one_epoch == (2 * 96) // 32
+    # the trained state is committed remotely
+    names = [u.rsplit("/", 1)[-1]
+             for u in store.list_prefix(model_url + "/")]
+    assert f"_COMMIT_{steps_one_epoch}" in names
+
+    # resume on a "new host": fresh staging, restores from the store and
+    # trains the second epoch on top
+    cfg2 = _train_cfg(data_url, model_url, num_epochs=2)
+    state2 = run_train(cfg2)
+    assert int(state2.step) == 2 * steps_one_epoch
+
+
+def test_write_predictions_to_url(store_env):
+    from deepfm_tpu.serve.export import write_predictions
+
+    _, base, store = store_env
+    url = f"{base}/bucket/out/pred.txt"
+    n = write_predictions(iter([np.array([0.25, 0.5]), 0.75]), url)
+    assert n == 3
+    assert store.get(url) == b"0.250000\n0.500000\n0.750000\n"
+
+
+def test_remote_clear_not_resurrected_by_stale_staging(store_env, tmp_path):
+    """Staging is a cache of the store: after clear_existing_model wipes the
+    remote prefix, a new checkpointer sharing the old staging dir must NOT
+    resurrect the cleared steps as latest_step."""
+    import jax.numpy as jnp
+
+    from deepfm_tpu.checkpoint import make_checkpointer, maybe_clear
+    from deepfm_tpu.core.config import MeshConfig
+    from deepfm_tpu.parallel import build_mesh, create_spmd_state, make_context
+
+    _, base, store = store_env
+    url = f"{base}/bucket/model_clear"
+    cfg = _train_cfg("unused", url)
+    mesh = build_mesh(MeshConfig(data_parallel=4, model_parallel=2))
+    ctx = make_context(cfg, mesh)
+    state = create_spmd_state(ctx)
+
+    stage = str(tmp_path / "stage_shared")
+    ck = make_checkpointer(url, staging_dir=stage)
+    ck.save(state._replace(step=jnp.asarray(7, state.step.dtype)),
+            block=True)
+    ck.close()
+    assert store.list_prefix(url + "/")
+
+    maybe_clear(url, True)
+    assert store.list_prefix(url + "/") == []
+
+    ck2 = make_checkpointer(url, staging_dir=stage)
+    assert ck2.latest_step() is None
+    ck2.close()
+
+
+def test_remote_restore_cross_topology(store_env, tmp_path):
+    """A checkpoint written under one mesh topology restores from the
+    store into a different one (the reshard fallback reaches through the
+    RemoteCheckpointer to the local Orbax manager after download)."""
+    from deepfm_tpu.checkpoint import make_checkpointer
+    from deepfm_tpu.core.config import MeshConfig
+    from deepfm_tpu.parallel import build_mesh, create_spmd_state, make_context
+    from deepfm_tpu.train.loop import restore_latest
+
+    _, base, store = store_env
+    url = f"{base}/bucket/model_reshard"
+    cfg = _train_cfg("unused", url)
+    mesh_a = build_mesh(MeshConfig(data_parallel=4, model_parallel=2))
+    ctx_a = make_context(cfg.with_overrides(
+        mesh={"data_parallel": 4, "model_parallel": 2}), mesh_a)
+    state_a = create_spmd_state(ctx_a)
+    ck = make_checkpointer(url, staging_dir=str(tmp_path / "stage_w"))
+    ck.save(state_a, block=True)
+    ck.close()
+
+    cfg_b = cfg.with_overrides(mesh={"data_parallel": 8, "model_parallel": 1})
+    mesh_b = build_mesh(MeshConfig(data_parallel=8, model_parallel=1))
+    ctx_b = make_context(cfg_b, mesh_b)
+    state_b = create_spmd_state(ctx_b)
+    ck2 = make_checkpointer(url, staging_dir=str(tmp_path / "stage_r"))
+    restored = restore_latest(ck2, ctx_b, state_b)
+    assert int(restored.step) == int(state_a.step)
+    np.testing.assert_allclose(
+        np.asarray(restored.params["fm_w"])[:FEATURE],
+        np.asarray(state_a.params["fm_w"])[:FEATURE], atol=1e-6)
+    ck2.close()
