@@ -38,6 +38,10 @@ _DEFAULT_SIGNALS = (signal.SIGTERM, signal.SIGINT)
 # exists to lose and default die-and-reschedule semantics are correct.
 _EARLY_SIGNAL = threading.Event()
 
+# which signals currently point at the record-only early handler, so a
+# guard's __exit__ can recognize it (see PreemptionGuard.__exit__)
+_EARLY_HANDLERS: dict[int, object] = {}
+
 
 def install_early_handler(signals=_DEFAULT_SIGNALS) -> bool:
     """Install a minimal record-only handler for the pre-guard window.
@@ -57,6 +61,7 @@ def install_early_handler(signals=_DEFAULT_SIGNALS) -> bool:
 
     for sig in signals:
         signal.signal(sig, _record)
+        _EARLY_HANDLERS[sig] = _record
     return True
 
 
@@ -103,7 +108,16 @@ class PreemptionGuard:
     def __exit__(self, *exc) -> None:
         if self._installed:
             for sig, prev in self._prev.items():
-                signal.signal(sig, prev)
+                if prev is not None and prev is _EARLY_HANDLERS.get(sig):
+                    # the pre-guard record-only handler: with no guard left
+                    # to consume the flag, it would swallow the FIRST
+                    # SIGTERM/SIGINT for the rest of the process (teardown,
+                    # retry backoff).  Training is over — restore default
+                    # die-and-reschedule semantics instead.
+                    signal.signal(sig, signal.SIG_DFL)
+                    _EARLY_HANDLERS.pop(sig, None)
+                else:
+                    signal.signal(sig, prev)
             self._prev.clear()
             self._installed = False
 

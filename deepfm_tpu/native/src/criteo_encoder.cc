@@ -204,11 +204,31 @@ static void frame_record(EncodeState& st) {
 }
 
 // Python float() parity: strtod over the WHOLE field (leading/trailing
-// whitespace tolerated, anything else rejects), arbitrary field length
+// whitespace tolerated, anything else rejects), arbitrary field length.
+// strtod's grammar is wider than Python's in two silent ways, both closed
+// here: hex floats ("0x1p3") are rejected, and an embedded NUL (which
+// would truncate the C-string parse and ACCEPT a field Python rejects)
+// is rejected up front.  The reverse direction — Python-only spellings
+// like underscore grouping ("1_0") or non-ASCII digits — is already a
+// rejection on this path, matching the documented contract that the
+// native encoder accepts a SUBSET of float() inputs byte-identically
+// (tests/test_criteo.py parity suite).
 static bool parse_full_double(EncodeState& st, const char* s, size_t n,
                               double* out) {
+    if (memchr(s, '\0', n) != nullptr) return false;
+    // strtod's NAN(char-seq) extension — Python float() rejects any
+    // parenthesized payload, so '(' anywhere in the field is a reject
+    if (memchr(s, '(', n) != nullptr) return false;
     st.inner.assign(s, n);
     const char* c = st.inner.c_str();
+    // reject strtod's hex-float extension: optional sign, then 0x/0X
+    const char* h = c;
+    while (*h == ' ' || *h == '\t' || *h == '\r' || *h == '\f' ||
+           *h == '\v') {
+        h++;
+    }
+    if (*h == '+' || *h == '-') h++;
+    if (h[0] == '0' && (h[1] == 'x' || h[1] == 'X')) return false;
     char* endp = nullptr;
     double x = std::strtod(c, &endp);
     if (endp == c) return false;

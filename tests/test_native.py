@@ -326,3 +326,17 @@ def test_criteo_hash_encode_no_stale_shards(tmp_path):
     paths2 = convert_criteo_to_tfrecords(
         small, out, CriteoHashEncoder(20_000), records_per_shard=100)
     assert len(paths2) == 2
+
+
+def test_criteo_hash_encode_rejects_strtod_extensions(tmp_path):
+    """ADVICE r04: strtod accepts hex floats ("0x1p3") that Python float()
+    rejects, and an embedded NUL truncates the C parse into a silent
+    accept.  Both must reject like the Python encoder does."""
+    for bad_field in ("0x1p3", "0X2", " -0x1 ", "1\x002", "nan(1)",
+                      "NAN(x)"):
+        line = "\t".join(["1"] + [bad_field] + ["5"] * 12 + ["tok"] * 26)
+        raw = tmp_path / "bad.tsv"
+        raw.write_bytes((line + "\n").encode())
+        with pytest.raises(ValueError):
+            native.criteo_hash_encode_file(
+                raw, tmp_path / "out", feature_size=20_000)

@@ -198,3 +198,19 @@ def test_run_with_restarts_preempted_not_retried():
     with pytest.raises(PreemptedError):
         run_with_restarts(preempted, max_restarts=5, backoff_secs=0.01)
     assert calls["n"] == 1
+
+
+def test_outermost_exit_restores_default_after_early_handler():
+    """ADVICE r04: once the last guard exits, the record-only early handler
+    must NOT linger (it would swallow the first SIGTERM of post-training
+    teardown); default semantics come back instead."""
+    from deepfm_tpu.launch import preemption as P
+
+    sig = signal.SIGUSR2
+    assert P.install_early_handler(signals=(sig,))
+    with PreemptionGuard(signals=(sig,)):
+        pass
+    try:
+        assert signal.getsignal(sig) is signal.SIG_DFL
+    finally:
+        signal.signal(sig, signal.SIG_DFL)
