@@ -66,11 +66,13 @@ class RemoteCheckpointer:
         if jax.process_count() > 1:
             # Orbax's collective save needs ONE shared directory all
             # processes write into; per-host staging mirrors would upload
-            # only process 0's shards — silent data loss.  Multi-host runs
-            # should point model_dir at shared storage (NFS/gcsfuse) or a
-            # gs:// path Orbax handles natively; the S3-wire mirror serves
-            # the reference's actual topology (a single logical writer,
-            # hvd:402-415 / PS master).
+            # only process 0's shards — silent data loss.  (Measured, not
+            # assumed: a 2-process probe passing per-process staging dirs
+            # deadlocks inside the save's directory-sync barrier.)
+            # Multi-host runs should point model_dir at shared storage
+            # (NFS/gcsfuse) or a gs:// path Orbax handles natively; the
+            # S3-wire mirror serves the reference's actual topology (a
+            # single logical writer, hvd:402-415 / PS master).
             raise ValueError(
                 "remote (URL) model_dir is single-process only; multi-host "
                 "runs need a shared filesystem or an Orbax-native gs:// "
