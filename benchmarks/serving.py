@@ -101,7 +101,7 @@ def main() -> None:
             print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
 
         # full HTTP round trip (TF Serving REST shape), single connection
-        import http.client
+
         import threading
 
         srv = ScoringHTTPServer(
@@ -123,7 +123,7 @@ def main() -> None:
                         for i in range(cb)
                     ]
                 })
-                conn = http.client.HTTPConnection("127.0.0.1", port)
+                conn = _connect_nodelay(port)
                 n_req = max(10, args.requests // 4)
                 # warm
                 conn.request("POST", "/v1/models/deepfm:predict", body,
@@ -154,7 +154,7 @@ def main() -> None:
                               "<i8", copy=False).tobytes()
                         + np.ascontiguousarray(vals).astype(
                               "<f4", copy=False).tobytes())
-                conn = http.client.HTTPConnection("127.0.0.1", port)
+                conn = _connect_nodelay(port)
                 n_req = max(10, args.requests // 4)
                 conn.request("POST", "/v1/models/deepfm:predict_binary",
                              body,
@@ -227,10 +227,22 @@ def main() -> None:
         )
 
 
+
+def _connect_nodelay(port: int):
+    """HTTPConnection with TCP_NODELAY: header+body write pairs on a
+    keep-alive socket otherwise hit Nagle+delayed-ACK (~40 ms/req)."""
+    import http.client
+    import socket as _socket
+
+    conn = http.client.HTTPConnection("127.0.0.1", port)
+    conn.connect()
+    conn.sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+    return conn
+
+
 def _concurrent_row(port: int, *, layer: str, path: str, body,
                     content_type: str, n_clients: int,
                     per_client: int) -> dict:
-    import http.client
     import threading
 
     lat: list[float] = []
@@ -238,7 +250,7 @@ def _concurrent_row(port: int, *, layer: str, path: str, body,
     errors: list[str] = []
 
     def client():
-        conn = http.client.HTTPConnection("127.0.0.1", port)
+        conn = _connect_nodelay(port)
         mine = []
         try:
             for _ in range(per_client):
@@ -318,12 +330,15 @@ def _pool_rows(servable: str, args) -> list[dict]:
                       "<i8", copy=False).tobytes()
                 + np.ascontiguousarray(vals).astype(
                       "<f4", copy=False).tobytes())
-        # wait for a worker to accept + compile
-        import http.client
+        # wait for a worker to accept + compile, then WARM EVERY worker:
+        # the kernel hashes fresh connections across listeners, so a burst
+        # of separate connections reaches all of them — otherwise the
+        # not-yet-compiled worker pays its first compile inside the
+        # measured sweep (observed as a seconds-scale p95 outlier)
         deadline = time.time() + 300
         while time.time() < deadline:
             try:
-                conn = http.client.HTTPConnection("127.0.0.1", port)
+                conn = _connect_nodelay(port)
                 conn.request("POST", "/v1/models/deepfm:predict_binary",
                              body,
                              {"Content-Type": "application/octet-stream"})
@@ -332,6 +347,30 @@ def _pool_rows(servable: str, args) -> list[dict]:
                     break
             except (ConnectionError, OSError):
                 time.sleep(0.5)
+        # deterministic warm: SO_REUSEPORT routes by 4-tuple hash, so a
+        # fixed burst can miss a worker; keep opening fresh connections
+        # until every distinct worker pid (X-Serving-Pid) has answered —
+        # each answer includes that worker's first compile if it was cold
+        seen_pids: set[str] = set()
+        for _ in range(64 * args.pool_workers):
+            if len(seen_pids) >= args.pool_workers:
+                break
+            try:
+                conn = _connect_nodelay(port)
+                conn.request("POST", "/v1/models/deepfm:predict_binary",
+                             body,
+                             {"Content-Type": "application/octet-stream"})
+                r = conn.getresponse()
+                r.read()
+                pid_h = r.getheader("X-Serving-Pid")
+                if pid_h:
+                    seen_pids.add(pid_h)
+                conn.close()
+            except (ConnectionError, OSError):
+                pass
+        if len(seen_pids) < args.pool_workers:
+            print(f"pool warm incomplete: saw {len(seen_pids)}/"
+                  f"{args.pool_workers} workers", file=sys.stderr)
         for n_clients in (16, 64):
             row = _concurrent_row(
                 port, layer="http_pool_binary",

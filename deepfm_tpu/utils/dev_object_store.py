@@ -35,6 +35,7 @@ def _make_handler(root: str, max_keys: int):
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        disable_nagle_algorithm = True  # keep-alive without 40ms Nagle stalls
 
         def log_message(self, *a):  # quiet
             pass
