@@ -1,0 +1,19 @@
+#!/bin/bash
+# Runs after the cpu_studies.sh process given by PID exits: the exposure
+# probe (multi-epoch confirmation of the capacity-ablation conclusion).
+# Waiting on an explicit PID avoids both pgrep races (matching unrelated
+# argv strings forever, or exiting early before the studies appear).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+
+WAIT_PID="${1:-}"
+if [ -n "$WAIT_PID" ]; then
+    while [ -d "/proc/$WAIT_PID" ]; do
+        sleep 60
+    done
+fi
+
+echo "== exposure probe (3-epoch lazy_tuned on the 5M study) =="
+nice -n 10 python benchmarks/exposure_probe.py || echo "exposure probe FAILED"
+echo "post_studies: done"

@@ -27,6 +27,7 @@ addressing.  Plain local paths (no scheme) are untouched by this module.
 
 from __future__ import annotations
 
+import http.client
 import os
 import threading
 import urllib.error
@@ -86,9 +87,23 @@ class HttpObjectStore:
 
     # -- data path ---------------------------------------------------------
     def open_read(self, url: str, *, offset: int = 0) -> BinaryIO:
-        """Streaming GET; ``offset`` issues a ``Range`` read (resume)."""
+        """Raw streaming GET; ``offset`` issues a ``Range`` read.
+
+        CAUTION: a connection dropped mid-body surfaces as a CLEAN EOF
+        under sized reads (urllib does not raise IncompleteRead for
+        ``read(n)``), i.e. silent truncation.  Data-plane consumers use
+        :meth:`open_read_resuming` instead."""
         headers = {"Range": f"bytes={offset}-"} if offset else {}
         return self._request("GET", url, headers=headers)
+
+    def open_read_resuming(self, url: str, *, offset: int = 0,
+                           max_resumes: int = 5) -> "ResumingStream":
+        """Streaming GET that survives mid-body connection drops (idle
+        timeouts on stalled streams, transient resets) by re-issuing a
+        ``Range`` read from the exact byte offset — the property the raw
+        response cannot give (see :meth:`open_read`)."""
+        return ResumingStream(self, url, offset=offset,
+                              max_resumes=max_resumes)
 
     def get(self, url: str) -> bytes:
         with self._request("GET", url) as r:
@@ -182,7 +197,7 @@ class HttpObjectStore:
             rel = url[len(base):]
             dest = os.path.join(local_dir, *rel.split("/"))
             os.makedirs(os.path.dirname(dest), exist_ok=True)
-            with self.open_read(url) as r, open(dest, "wb") as f:
+            with self.open_read_resuming(url) as r, open(dest, "wb") as f:
                 while True:
                     chunk = r.read(1 << 20)
                     if not chunk:
@@ -190,6 +205,74 @@ class HttpObjectStore:
                     f.write(chunk)
             out.append(dest)
         return out
+
+
+class ResumingStream:
+    """File-like streaming GET body with drop-resume.
+
+    Tracks delivered bytes against the first response's Content-Length;
+    a premature EOF or mid-read network error triggers a ranged re-GET
+    from the exact offset (bounded retries, exponential backoff).  Without
+    this, a dropped connection reads as clean EOF under sized reads and an
+    epoch silently truncates — worse, a drop landing exactly on a TFRecord
+    boundary is undetectable by framing alone.
+    """
+
+    def __init__(self, store: HttpObjectStore, url: str, *,
+                 offset: int = 0, max_resumes: int = 5):
+        self._store = store
+        self._url = url
+        self._offset = offset
+        self._max_resumes = max_resumes
+        self._resumes = 0
+        self._resp = store.open_read(url, offset=offset)
+        cl = self._resp.headers.get("Content-Length")
+        self._total = offset + int(cl) if cl is not None else None
+
+    def _resume(self) -> None:
+        import time
+
+        self._resumes += 1
+        if self._resumes > self._max_resumes:
+            raise ObjectStoreError(
+                f"stream {self._url} dropped at byte {self._offset}"
+                + (f"/{self._total}" if self._total is not None else "")
+                + f" after {self._max_resumes} resume attempts"
+            )
+        time.sleep(min(2.0 ** self._resumes * 0.1, 5.0))
+        try:
+            self._resp.close()
+        except Exception:
+            pass
+        self._resp = self._store.open_read(self._url, offset=self._offset)
+
+    def read(self, n: int = -1) -> bytes:
+        while True:
+            try:
+                chunk = self._resp.read(n)
+            except (OSError, http.client.HTTPException):
+                # partial data buffered inside the failed read is NOT
+                # counted in _offset, so the ranged resume re-fetches it
+                self._resume()
+                continue
+            if chunk:
+                self._offset += len(chunk)
+                return chunk
+            if self._total is None or self._offset >= self._total:
+                return b""  # genuine end of object
+            self._resume()  # premature clean EOF == dropped connection
+
+    def close(self) -> None:
+        try:
+            self._resp.close()
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 _DEFAULT_STORE: HttpObjectStore | None = None
@@ -203,9 +286,10 @@ def get_store() -> HttpObjectStore:
 
 
 def open_source(src: str, *, offset: int = 0) -> BinaryIO:
-    """Open a local path or object URL for streaming reads."""
+    """Open a local path or object URL for streaming reads (URL streams
+    resume dropped connections transparently)."""
     if is_url(src):
-        return get_store().open_read(src, offset=offset)
+        return get_store().open_read_resuming(src, offset=offset)
     f = open(src, "rb")
     if offset:
         f.seek(offset)
@@ -218,10 +302,16 @@ class FifoBridge:
 
     Memory is bounded by the kernel pipe buffer: the writer thread first
     waits for a reader on the FIFO (non-blocking open + poll, so it stays
-    cancellable), THEN issues the GET — no server-side read timeout ticks
-    while the consumer is still working through earlier sources, and a
-    consumer that exits early can reap the bridge via ``close()``.
+    cancellable), THEN issues the GET, and a consumer that exits early can
+    reap the bridge via ``close()``.  A connection dropped mid-stream —
+    which object stores do to idle or long-lived GETs, e.g. when the
+    concurrent-reader merger keeps a later source's stream stalled behind
+    earlier sources — is RESUMED with a ranged re-GET from the exact byte
+    offset (bounded retries), so a drop costs a reconnect, not a silently
+    truncated epoch.
     """
+
+    _MAX_RESUMES = 5
 
     def __init__(self, url: str, fifo_dir: str, name: str):
         self.url = url
@@ -250,7 +340,9 @@ class FifoBridge:
                     raise
             os.set_blocking(fd, True)
             with os.fdopen(fd, "wb") as sink:
-                with get_store().open_read(self.url) as r:
+                with get_store().open_read_resuming(
+                    self.url, max_resumes=self._MAX_RESUMES
+                ) as r:
                     while True:
                         chunk = r.read(1 << 20)
                         if not chunk:

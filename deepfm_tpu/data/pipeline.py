@@ -79,9 +79,9 @@ def record_stream(
     n = decision.num_shards if decision else 1
     mine = decision.shard_index if decision else 0
     for src in sources:
-        # object URLs stream through a live HTTP response (bounded memory);
-        # read_records consumes any binary file-like identically
-        stream = get_store().open_read(src) if is_url(src) else None
+        # object URLs stream through a live HTTP response (bounded memory,
+        # drop-resuming); read_records consumes any binary file-like
+        stream = get_store().open_read_resuming(src) if is_url(src) else None
         try:
             for rec in read_records(
                 stream if stream is not None else src, verify=verify_crc
@@ -175,9 +175,10 @@ def ctr_batches_from_sources(
     if native.available() and any(is_url(s) for s in sources):
         # Remote sources ride the native decode path through FIFO bridges
         # (the C++ reader is already FIFO-capable for pipe-mode parity).
-        # Each bridge's writer thread blocks opening its FIFO until the
-        # reader reaches that source, so at most one HTTP stream is live
-        # at a time and memory stays bounded at the kernel pipe buffer.
+        # Each bridge's writer thread blocks opening its FIFO until a
+        # reader opens that source, so live HTTP streams are bounded by
+        # the consumer's concurrency (1 sequential, parallel_readers with
+        # the concurrent merger) and memory by the kernel pipe buffer.
         import tempfile
 
         from .object_store import FifoBridge
@@ -204,7 +205,7 @@ def ctr_batches_from_sources(
                     permute_vocab=permute_vocab,
                     verify_crc=verify_crc,
                     skip_counter=skip_counter,
-                    parallel_readers=1,
+                    parallel_readers=parallel_readers,
                 )
                 completed = True
             finally:
