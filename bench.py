@@ -43,6 +43,9 @@ import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "benchmarks"))
+
 NORTH_STAR_PER_CHIP = 1_000_000 / 64  # examples/sec/chip
 V, F, K = 117_581, 39, 32
 DEEP = (128, 64, 32)
@@ -67,9 +70,11 @@ def _probe_tpu(timeout_s: int) -> bool:
     env["JAX_PLATFORMS"] = "axon"
     env.pop("DEEPFM_BENCH_FALLBACK", None)
     code = (
+        # value fetch, not block_until_ready: the latter can return with
+        # the remote execute outstanding (racy on the tunneled attach)
         "import jax, jax.numpy as jnp; "
         "f = jax.jit(lambda x: (x @ x).sum()); "
-        "f(jnp.ones((128, 128))).block_until_ready(); print('OK')"
+        "print('OK', float(f(jnp.ones((128, 128)))))"
     )
     try:
         r = subprocess.run(
@@ -187,22 +192,17 @@ BATCH = 1024
 
 
 def _time_loop(step_fn, state, bs) -> tuple[float, float]:
-    import jax
+    """Fetch-based timing via the shared helper (_bench_util.time_step_loop):
+    block_until_ready can return with remote work still outstanding on the
+    tunneled attach (racy; measured round 5 — docs/TPU_REPORT.md), so the
+    timed region ends with a device->host value fetch whose measured wire
+    RTT is subtracted.  One timing policy, one implementation."""
+    import _bench_util as bu
 
-    nb = len(bs)
     # examples per dispatch: [B] single-step or [K, B] stacked-scan batches
     batch_size = int(np.prod(bs[0]["label"].shape))
-    for i in range(3):  # warmup (compile + first dispatches)
-        state, metrics = step_fn(state, bs[i % nb])
-    jax.block_until_ready(metrics)
-    t0 = time.perf_counter()
-    for i in range(STEPS):
-        state, metrics = step_fn(state, bs[i % nb])
-    jax.block_until_ready(metrics)
-    dt = time.perf_counter() - t0
-    # scan variants return stacked [K] metrics; report the last sub-step
-    final_loss = float(np.asarray(metrics["loss"]).reshape(-1)[-1])
-    return STEPS * batch_size / dt, final_loss
+    r = bu.time_step_loop(step_fn, state, bs, STEPS, batch_size)
+    return r["examples_per_sec"], r["final_loss"]
 
 
 def measure(fused: str, lazy: bool = False) -> tuple[float, float]:
@@ -368,6 +368,9 @@ def main() -> None:
         "final_loss": round(final_loss, 4),
         "variant": best,
         "variants": {k: round(v[0], 1) for k, v in rates.items()},
+        # round 5: fetch-based timing (block_until_ready is racy on the
+        # tunneled attach; pre-round-5 TPU rows were block-timed — suspect)
+        "timing_method": "fetch",
     }
     roof = dense_adam_roofline(platform, _device_kind(platform))
     xla_rate = rates.get("xla", (0.0, 0.0))[0]

@@ -42,17 +42,22 @@ from jax import shard_map  # noqa: E402
 
 
 def _time(fn, *args, iters=20):
+    import _bench_util as bu
+
     out = fn(*args)
-    jax.block_until_ready(out)
+    bu.device_sync(out)
+    rtt = bu.measure_rtt(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-        # block per iteration: >1 in-flight sharded program can deadlock
+        # sync per iteration: >1 in-flight sharded program can deadlock
         # XLA:CPU's shared thunk executor at a collective rendezvous
-        # (train/loop.py _cpu_serialize_dispatch); on TPU this only adds
-        # one host sync per iteration to an already-measured dispatch
-        jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+        # (train/loop.py _cpu_serialize_dispatch); on TPU the sync is a
+        # value FETCH (block_until_ready is racy on the tunneled attach)
+        # whose per-iteration RTT is measured above and subtracted
+        bu.device_sync(out)
+    dt = max(time.perf_counter() - t0 - rtt * iters, 1e-9)
+    return dt / iters
 
 
 def bench_collectives(mesh: Mesh, size_mb: float, iters: int) -> list[dict]:

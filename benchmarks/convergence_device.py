@@ -247,7 +247,9 @@ def main() -> None:
     for ep in range(args.epochs):
         t0 = time.perf_counter()
         state, losses = train_epoch(state, ep)
-        jax.block_until_ready(losses)
+        # fetch-based barrier: block_until_ready is racy on the tunneled
+        # attach (docs/TPU_REPORT.md round 5); one small fetch per epoch
+        np.asarray(losses).reshape(-1)[-1]
         train_s = time.perf_counter() - t0
         t0 = time.perf_counter()
         s_auc, t_auc, ce = map(float, eval_pass(state))

@@ -35,6 +35,9 @@ sanitize_backend()
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _bench_util as bu  # noqa: E402  (fetch-based device_sync)
+
 V, F, K = 117_581, 39, 32
 BATCH = 1024
 
@@ -193,12 +196,14 @@ def main() -> None:
         ]
         for i in range(3):
             state, m = step_fn(state, staged[i % len(staged)])
-        jax.block_until_ready(m)
+        bu.device_sync(m)
+        rtt = bu.measure_rtt(m)
         t0 = time.perf_counter()
         for i in range(args.steps):
             state, m = step_fn(state, staged[i % len(staged)])
-        jax.block_until_ready(m)
-        step_rate = args.steps * BATCH / (time.perf_counter() - t0)
+        bu.device_sync(m)
+        step_rate = args.steps * BATCH / max(
+            time.perf_counter() - t0 - rtt, 1e-9)
         result["step_only_ex_per_sec"] = round(step_rate, 1)
 
         # --- end to end, file mode ---------------------------------------
@@ -216,7 +221,7 @@ def main() -> None:
                 for b in pf:
                     st, mm = fn(st, b)
                     n += BATCH
-            jax.block_until_ready(mm)
+            bu.device_sync(mm)
             return n / (time.perf_counter() - t0)
 
         rate = run_e2e(
@@ -262,7 +267,7 @@ def main() -> None:
                 for b in pf:
                     st, mm = fn(st, b)
                     n += BATCH * k
-            jax.block_until_ready(mm)
+            bu.device_sync(mm)
             return n / (time.perf_counter() - t0)
 
         rate = run_e2e_scan(

@@ -54,6 +54,9 @@ relax_cpu_collective_timeouts()
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _bench_util as bu  # noqa: E402  (fetch-based device_sync)
+
 F, K_DEFAULT, BATCH = 39, 32, 1024
 
 
@@ -151,7 +154,7 @@ def main() -> None:
     )
     ctx_a = make_context(cfg_a, mesh_a)
     state = create_spmd_state(ctx_a)
-    jax.block_until_ready(state.params["fm_v"])
+    bu.device_sync(state.params["fm_v"])
     phase(f"init_dp{sdp}xmp{smp}", t0)
 
     # ---- 2. lazy train steps ------------------------------------------
@@ -173,13 +176,14 @@ def main() -> None:
     t0 = time.perf_counter()
     step_fn = make_spmd_train_step(ctx_a)
     state, metrics = step_fn(state, batches[0])  # compile + step 1
-    jax.block_until_ready(metrics["loss"])
+    bu.device_sync(metrics["loss"])
     phase("compile_and_first_step", t0)
+    rtt = bu.measure_rtt(metrics["loss"])
     t0 = time.perf_counter()
     for i in range(1, args.steps):
         state, metrics = step_fn(state, batches[i % nb])
-        jax.block_until_ready(metrics["loss"])
-    dt = time.perf_counter() - t0
+        bu.device_sync(metrics["loss"])
+    dt = max(time.perf_counter() - t0 - rtt * max(1, args.steps - 1), 1e-9)
     result["train_step_ms"] = round(1e3 * dt / max(1, args.steps - 1), 1)
     result["train_examples_per_sec"] = round(
         (args.steps - 1) * BATCH / dt, 1
@@ -203,13 +207,14 @@ def main() -> None:
         for i in range(2)
     ]
     state, sm = loop_fn(state, stacked[0])        # compile + first dispatch
-    jax.block_until_ready(sm["loss"])
+    bu.device_sync(sm["loss"])
+    rtt = bu.measure_rtt(sm["loss"])
     n_disp = max(1, (args.steps + k - 1) // k)
     t0 = time.perf_counter()
     for i in range(n_disp):
         state, sm = loop_fn(state, stacked[i % 2])
-    jax.block_until_ready(sm["loss"])
-    dt = time.perf_counter() - t0
+    bu.device_sync(sm["loss"])
+    dt = max(time.perf_counter() - t0 - rtt, 1e-9)
     result["train_scan8_step_ms"] = round(1e3 * dt / (n_disp * k), 2)
     result["train_scan8_examples_per_sec"] = round(n_disp * k * BATCH / dt, 1)
     phase("train_scan8", t0)
@@ -257,7 +262,7 @@ def main() -> None:
     ctx_b = make_context(cfg_b, mesh_b)
     t0 = time.perf_counter()
     restored = restore_resharded(ckpt, ctx_b)
-    jax.block_until_ready(restored.params["fm_v"])
+    bu.device_sync(restored.params["fm_v"])
     phase(f"restore_resharded_dp{ddp}xmp{dmp}", t0)
     assert int(restored.step) == saved_step
 
@@ -277,9 +282,9 @@ def main() -> None:
     sb = shard_batch(ctx_b, b0, validate_ids=False)
     t0 = time.perf_counter()
     restored, m2 = step_fn_b(restored, sb)
-    jax.block_until_ready(m2["loss"])
+    bu.device_sync(m2["loss"])
     restored, m2 = step_fn_b(restored, sb)
-    jax.block_until_ready(m2["loss"])
+    bu.device_sync(m2["loss"])
     phase("post_restore_steps", t0)
     assert int(restored.step) == saved_step + 2
     result["post_restore_loss"] = round(float(m2["loss"]), 4)

@@ -93,29 +93,39 @@ def _time_both(step_fn, state, batches, dispatches: int, sync_reps: int,
     """Pipelined rate + per-dispatch blocked latency for one compiled fn.
 
     The state is threaded (donated buffers), so sync timing reuses the
-    pipelined loop's final state."""
-    import jax
+    pipelined loop's final state.
+
+    Timing is FETCH-based, not block-based: jax.block_until_ready can
+    return while remote execution is outstanding on the tunneled attach
+    (racy — measured round 5, docs/TPU_REPORT.md), which once produced a
+    1.3e9 ex/s artifact.  Every timed region ends with a device->host
+    value fetch (bu.device_sync); the fetch's own wire RTT is measured on
+    already-complete buffers and subtracted from the pipelined region."""
+    import numpy as np
 
     nb = len(batches)
     for i in range(2):  # compile + first dispatch
         state, metrics = step_fn(state, batches[i % nb])
-    jax.block_until_ready(metrics)
+    bu.device_sync(metrics)
+    rtt = bu.measure_rtt(metrics)
     t0 = time.perf_counter()
     for i in range(dispatches):
         state, metrics = step_fn(state, batches[i % nb])
-    jax.block_until_ready(metrics)
-    dt = time.perf_counter() - t0
+    bu.device_sync(metrics)
+    dt = max(time.perf_counter() - t0 - rtt, 1e-9)
     t0 = time.perf_counter()
     for i in range(sync_reps):
         state, metrics = step_fn(state, batches[i % nb])
-        jax.block_until_ready(metrics)
+        bu.device_sync(metrics)
     dt_sync = time.perf_counter() - t0
-    import numpy as np
 
     return {
         "examples_per_sec": round(dispatches * examples_per_dispatch / dt, 1),
         "dispatch_ms_pipelined": round(dt / dispatches * 1e3, 3),
+        # includes one fetch RTT per dispatch (the host-round-trip floor
+        # when every step's metrics are read synchronously)
         "dispatch_ms_sync": round(dt_sync / sync_reps * 1e3, 3),
+        "sync_rtt_ms": round(rtt * 1e3, 3),
         "final_loss": round(
             float(np.asarray(metrics["loss"]).reshape(-1)[-1]), 4),
     }
@@ -137,7 +147,7 @@ def measure(variant: str, batch_size: int, dispatches: int,
         t0 = time.perf_counter()
         batches = [{kk: jax.device_put(vv) for kk, vv in hb.items()}
                    for hb in _host_batches(batch_size, 8)]
-        jax.block_until_ready(batches)
+        bu.device_sync_all(batches)
         stage_s = time.perf_counter() - t0
         r = _time_both(step_fn, state, batches, dispatches, sync_reps,
                        batch_size)
@@ -165,7 +175,7 @@ def measure(variant: str, batch_size: int, dispatches: int,
     else:
         step_fn = make_spmd_train_step(ctx)
         staged = [shard_batch(ctx, hb, validate_ids=False) for hb in host]
-    jax.block_until_ready(staged)
+    bu.device_sync_all(staged)
     stage_s = time.perf_counter() - t0
     r = _time_both(step_fn, state, staged, dispatches, sync_reps,
                    batch_size * k)

@@ -47,7 +47,7 @@ commit_with_retry() {
         docs/BENCH_TRANSFER.json docs/BENCH_TPU_TUNE.json \
         docs/BENCH_MODEL_ZOO.json docs/BENCH_CONVERGENCE_DEVICE.json \
         docs/BENCH_SERVING.json docs/BENCH_SPMD_SWEEP.json \
-        docs/BENCH_PALLAS_10M.json \
+        docs/BENCH_PALLAS_10M.json docs/BENCH_ATTRIBUTION.json \
         docs/TPU_WATCHER_LOG.jsonl docs/TPU_SESSION_OUT.log \
         docs/TPU_MICRO_SESSION_OUT.log; do
         [[ -e $p ]] && paths+=("$p")
@@ -104,7 +104,7 @@ import jax; assert jax.devices()" >/dev/null 2>&1; then
         if JAX_PLATFORMS=axon timeout "$PROBE_TIMEOUT" python -c "
 import jax, jax.numpy as jnp
 f = jax.jit(lambda x: (x @ x).sum())
-print('OK', f(jnp.ones((128, 128))).block_until_ready())" \
+print('OK', float(f(jnp.ones((128, 128)))))" \
             >/dev/null 2>&1; then
             dt=$(( $(date +%s) - t0 ))
             log_attempt "attach_ok" "$dt"
