@@ -74,6 +74,17 @@ class ModelConfig:
     temperature: float = 0.05
     # compute dtype for the MLP/FM math (params stay f32; bf16 feeds the MXU)
     compute_dtype: str = "bfloat16"
+    # int64->int32 id narrowing when the vocab is int32-addressable (TPU has
+    # no native 64-bit integer datapath).  On by default; the switch exists
+    # for the id-dtype cost ablation (benchmarks/attribution.py)
+    narrow_ids: bool = True
+    # embedding-table gradient strategy: "scatter" = the gather's default
+    # VJP (one scatter-add update per lookup; XLA:TPU serializes colliding
+    # rows) | "segsum" = sort + segment-sum + one sorted-unique write per
+    # distinct row (ops/embedding.py segsum_lookup).  Default stays
+    # "scatter" until the TPU attribution bench decides
+    # (benchmarks/attribution.py; round-5 finding in docs/TPU_REPORT.md)
+    table_grad: str = "scatter"
     # Pallas fused gather+FM kernel (ops/pallas_ctr.py): "off" | "auto" | "on".
     # "auto" uses it on TPU backends; "on" forces it (interpret mode on CPU).
     fused_kernel: str = "off"
@@ -92,6 +103,11 @@ class ModelConfig:
             raise ValueError(
                 f"fused_kernel must be 'off', 'auto' or 'on', "
                 f"got {self.fused_kernel!r}"
+            )
+        if self.table_grad not in ("scatter", "segsum"):
+            raise ValueError(
+                f"table_grad must be 'scatter' or 'segsum', "
+                f"got {self.table_grad!r}"
             )
 
 

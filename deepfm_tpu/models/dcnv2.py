@@ -24,7 +24,8 @@ import jax.numpy as jnp
 
 from ..core.config import ModelConfig
 from ..ops.batch_norm import bn_init
-from ..ops.embedding import dense_lookup, scaled_embedding
+from ..ops.embedding import (dense_lookup, narrow_ids, scaled_embedding,
+                             segsum_lookup)
 from ..ops.initializers import glorot_normal, glorot_uniform
 from .base import register_model
 from .deepfm import apply_mlp, deepfm_l2_penalty, init_mlp
@@ -90,8 +91,11 @@ def apply_dcnv2(
     rng: jax.Array | None = None,
     lookup_fn=dense_lookup,
 ) -> tuple[jnp.ndarray, dict]:
-    feat_ids = feat_ids.reshape(-1, cfg.field_size)
+    feat_ids = narrow_ids(feat_ids.reshape(-1, cfg.field_size),
+                          cfg.feature_size, cfg.narrow_ids)
     feat_vals = feat_vals.reshape(-1, cfg.field_size).astype(jnp.float32)
+    if lookup_fn is dense_lookup and cfg.table_grad == "segsum":
+        lookup_fn = segsum_lookup  # sorted-unique-write backward
 
     if lookup_fn is dense_lookup:
         emb = scaled_embedding(params["fm_v"], feat_ids, feat_vals)

@@ -25,7 +25,8 @@ import jax.numpy as jnp
 
 from ..core.config import ModelConfig
 from ..ops.batch_norm import batch_norm, bn_init
-from ..ops.embedding import dense_lookup, scaled_embedding
+from ..ops.embedding import (dense_lookup, narrow_ids, scaled_embedding,
+                             segsum_lookup)
 from ..ops.fm import fm_first_order, fm_second_order
 from ..ops.initializers import glorot_normal, glorot_uniform
 from ..ops.pallas_ctr import fused_ctr_interaction, resolve_fused
@@ -137,7 +138,8 @@ def apply_deepfm(
     lookup_fn=dense_lookup,
 ) -> tuple[jnp.ndarray, dict]:
     """Forward pass: [B, F] int ids + [B, F] f32 vals -> [B] logits."""
-    feat_ids = feat_ids.reshape(-1, cfg.field_size)
+    feat_ids = narrow_ids(feat_ids.reshape(-1, cfg.field_size),
+                          cfg.feature_size, cfg.narrow_ids)
     feat_vals = feat_vals.reshape(-1, cfg.field_size).astype(jnp.float32)
 
     if cfg.fused_kernel == "on" and lookup_fn is not dense_lookup:
@@ -164,6 +166,8 @@ def apply_deepfm(
             not is_tpu_backend(),  # interpret on CPU (tests)
         )
     else:
+        if lookup_fn is dense_lookup and cfg.table_grad == "segsum":
+            lookup_fn = segsum_lookup  # sorted-unique-write backward
         # first order (ps:206-209)
         feat_w = lookup_fn(params["fm_w"], feat_ids)        # [B, F]
         y_w = fm_first_order(feat_w, feat_vals)

@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.config import ModelConfig
-from ..ops.embedding import dense_lookup
+from ..ops.embedding import dense_lookup, narrow_ids, segsum_lookup
 from ..ops.initializers import glorot_normal, glorot_uniform
 
 
@@ -114,8 +114,12 @@ def encode_tower(
     tower MLP -> L2-normalized [B, D].  The serving-time entry point for
     encoding query users or corpus items independently."""
     field = cfg.user_field_size if side == "user" else cfg.item_field_size
-    ids = ids.reshape(-1, field)
+    ids = narrow_ids(ids.reshape(-1, field),
+                     user_vocab(cfg) if side == "user" else item_vocab(cfg),
+                     cfg.narrow_ids)
     vals = vals.reshape(-1, field).astype(jnp.float32)
+    if lookup_fn is dense_lookup and cfg.table_grad == "segsum":
+        lookup_fn = segsum_lookup  # sorted-unique-write backward
     emb = lookup_fn(params[f"{side}_embedding"], ids) * vals[..., None]
     return _apply_tower(
         params[f"{side}_tower"],

@@ -28,7 +28,8 @@ import jax.numpy as jnp
 
 from ..core.config import ModelConfig
 from ..ops.batch_norm import bn_init
-from ..ops.embedding import dense_lookup, scaled_embedding
+from ..ops.embedding import (dense_lookup, narrow_ids, scaled_embedding,
+                             segsum_lookup)
 from ..ops.fm import fm_first_order
 from ..ops.initializers import glorot_normal, glorot_uniform
 from .base import register_model
@@ -123,8 +124,11 @@ def apply_xdeepfm(
     rng: jax.Array | None = None,
     lookup_fn=dense_lookup,
 ) -> tuple[jnp.ndarray, dict]:
-    feat_ids = feat_ids.reshape(-1, cfg.field_size)
+    feat_ids = narrow_ids(feat_ids.reshape(-1, cfg.field_size),
+                          cfg.feature_size, cfg.narrow_ids)
     feat_vals = feat_vals.reshape(-1, cfg.field_size).astype(jnp.float32)
+    if lookup_fn is dense_lookup and cfg.table_grad == "segsum":
+        lookup_fn = segsum_lookup  # sorted-unique-write backward
 
     feat_w = lookup_fn(params["fm_w"], feat_ids)
     y_w = fm_first_order(feat_w, feat_vals)

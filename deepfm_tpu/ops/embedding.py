@@ -10,6 +10,25 @@ agnostic to the sharding strategy.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
+
+# TPU has no native 64-bit integer datapath: int64 index arithmetic runs on
+# an emulated 32-bit-pair representation and int64 gather/scatter indices
+# double the index traffic and can force slower lowerings.  Any vocabulary
+# that fits int32 should index with int32 on device.
+_INT32_MAX_ROWS = 2**31 - 1
+
+
+def narrow_ids(ids, vocab_size: int, enabled: bool = True):
+    """Cast int64 ids to int32 when every row of a ``vocab_size``-row table
+    is addressable in 32 bits.  Works on host numpy arrays (cast before the
+    device transfer — halves the id bytes moved) and on traced/device
+    arrays (a cheap elementwise op XLA fuses away).  No-op for int32 input,
+    an int32-unsafe vocabulary, or ``enabled=False``
+    (``ModelConfig.narrow_ids``, the ablation switch)."""
+    if enabled and ids.dtype == np.int64 and vocab_size <= _INT32_MAX_ROWS:
+        return ids.astype(np.int32)
+    return ids
 
 
 def dense_lookup(table: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
@@ -29,3 +48,92 @@ def scaled_embedding(
     table [V, K], ids [B, F], vals [B, F] -> [B, F, K].
     """
     return dense_lookup(table, ids) * vals[..., None]
+
+
+def sort_segments(flat_ids: jnp.ndarray):
+    """Sort ids and describe the equal-id runs.
+
+    Returns ``(order, seg, row_id, valid)``: ``order`` sorts the ids,
+    ``seg[p]`` is the segment index of sorted position p, ``row_id[s]`` the
+    id shared by segment s, ``valid[s]`` whether segment s exists (segments
+    form a prefix).  One structure serves every table gathered with the
+    same ids (the lazy-Adam update and the segsum backward below)."""
+    n = flat_ids.shape[0]
+    order = jnp.argsort(flat_ids)
+    sid = flat_ids[order]
+    first = jnp.concatenate([jnp.ones((1,), bool), sid[1:] != sid[:-1]])
+    seg = jnp.cumsum(first) - 1
+    row_id = jnp.zeros((n,), sid.dtype).at[seg].set(
+        sid, indices_are_sorted=True
+    )
+    valid = jnp.arange(n) < jnp.sum(first)
+    return order, seg, row_id, valid
+
+
+def _segsum_meta(table) -> tuple:
+    return (tuple(table.shape), str(table.dtype))
+
+
+def _segsum_impl(meta, table, ids):
+    return jnp.take(table, ids, axis=0, mode="clip")
+
+
+def _segsum_fwd(meta, table, ids):
+    return _segsum_impl(meta, table, ids), ids
+
+
+def _segsum_bwd(meta, ids, g):
+    import jax
+
+    shape, dtype = meta
+    rows, tail = shape[0], tuple(shape[1:])
+    flat_ids = ids.reshape(-1)
+    n = flat_ids.shape[0]
+    flat_g = g.reshape((n,) + tail)
+    order, seg, row_id, valid = sort_segments(flat_ids)
+    summed = jax.ops.segment_sum(
+        flat_g[order], seg, num_segments=n, indices_are_sorted=True
+    )
+    # one write per UNIQUE row; empty segments target distinct out-of-range
+    # rows (rows + position) so the index vector stays sorted AND unique —
+    # XLA can emit a vectorized scatter instead of a serialized one
+    write = jnp.where(valid, row_id, rows + jnp.arange(n, dtype=row_id.dtype))
+    grad = jnp.zeros((rows,) + tail, dtype).at[write].add(
+        summed.astype(dtype), indices_are_sorted=True, unique_indices=True,
+        mode="drop",
+    )
+    import numpy as _np
+
+    return grad, _np.zeros(ids.shape, jax.dtypes.float0)
+
+
+def _make_segsum_call():
+    import functools
+
+    import jax
+
+    call = jax.custom_vjp(_segsum_impl, nondiff_argnums=(0,))
+    call.defvjp(_segsum_fwd, _segsum_bwd)
+    return call
+
+
+_SEGSUM_CALL = _make_segsum_call()
+
+
+def segsum_lookup(table: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
+    """``dense_lookup`` with a sort+segment-sum backward.
+
+    The gather's default VJP is a scatter-add with one update per LOOKUP
+    (B·F of them, duplicate rows colliding) — the pattern XLA:TPU
+    serializes, measured at ~9-16 ms/step for the flagship shape (round-5
+    finding, docs/TPU_REPORT.md).  This variant's backward sorts the ids
+    once, segment-sums duplicate rows' cotangents, and issues ONE
+    sorted-unique write per distinct row — the same dedup structure the
+    lazy-Adam update uses (train/lazy.py).  Forward is identical
+    (clip-mode gather); select with ``ModelConfig.table_grad='segsum'``.
+
+    Numerical note: duplicate rows' contributions are summed in sorted-id
+    order instead of scatter order; f32 addition reorders, so gradients
+    match the scatter backward to float tolerance, not bit-exactly
+    (tests/test_segsum_grad.py)."""
+    return _SEGSUM_CALL(_segsum_meta(table), table, ids)

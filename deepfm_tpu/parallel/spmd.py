@@ -210,7 +210,7 @@ def _pmean_grads(grads: dict) -> dict:
 
 
 def _local_loss(cfg: Config, model, params, model_state, batch, rng, train):
-    lookup = make_sharded_lookup_fn()
+    lookup = make_sharded_lookup_fn(table_grad=cfg.model.table_grad)
     logits, new_state = model.apply(
         params,
         model_state,
@@ -376,7 +376,11 @@ def _build_lazy_local_step(ctx: SPMDContext, model, tx) -> Callable:
         keys = [k for k in LAZY_TABLE_KEYS if k in params]
         rest = {k: v for k, v in params.items() if k not in keys}
         tables = {k: params[k] for k in keys}          # local row shards
-        ids2d = batch["feat_ids"].reshape(-1, cfg.model.field_size)
+        from ..ops.embedding import narrow_ids
+
+        ids2d = narrow_ids(batch["feat_ids"], cfg.model.feature_size,
+                           cfg.model.narrow_ids)
+        ids2d = ids2d.reshape(-1, cfg.model.field_size)
         rows = {k: sharded_lookup(tables[k], ids2d) for k in keys}
 
         def loss_fn(rest, rows):
@@ -580,6 +584,24 @@ def _validate_local_batch(ctx: SPMDContext, b: int, ids) -> int:
     return nproc
 
 
+def _narrow_id_fields(ctx: SPMDContext, batch: dict) -> dict:
+    """Host-side int64→int32 cast of every ``*_ids`` field when the padded
+    vocabulary is int32-addressable: TPUs have no native 64-bit integer
+    datapath, and casting BEFORE device_put also halves the id bytes on the
+    wire (ops/embedding.py narrow_ids)."""
+    from ..ops.embedding import narrow_ids
+
+    m = ctx.cfg.model
+    # the two-tower vocabs may differ from feature_size; the cast is safe
+    # only if the LARGEST table stays int32-addressable
+    vocab = max(m.feature_size, getattr(m, "user_vocab_size", 0) or 0,
+                getattr(m, "item_vocab_size", 0) or 0)
+    return {
+        k: narrow_ids(v, vocab, m.narrow_ids) if k.endswith("_ids") else v
+        for k, v in batch.items()
+    }
+
+
 def shard_batch(ctx: SPMDContext, batch: dict, *, validate_ids: bool = True) -> dict:
     """Place a host batch onto the mesh (data-sharded, model-replicated).
 
@@ -604,6 +626,7 @@ def shard_batch(ctx: SPMDContext, batch: dict, *, validate_ids: bool = True) -> 
         ctx, batch["label"].shape[0],
         batch.get("feat_ids") if validate_ids else None,
     )
+    batch = _narrow_id_fields(ctx, batch)
     if nproc > 1:
         import numpy as np
 
@@ -635,6 +658,7 @@ def shard_batch_stacked(
         ctx, stacked["label"].shape[1],
         stacked.get("feat_ids") if validate_ids else None,
     )
+    stacked = _narrow_id_fields(ctx, stacked)
     shardings = {
         k: NamedSharding(
             ctx.mesh, P(*((None,) + tuple(ctx.batch_specs[k])))

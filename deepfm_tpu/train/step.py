@@ -178,7 +178,7 @@ def _make_lazy_train_step(cfg: Config, model, tx) -> Callable:
     via touched-rows-only lazy Adam.  The CE loss drops the dense table-L2
     term (ps:275-279); its gradient ``l2·w`` is applied inside the lazy
     update on touched rows instead (see train/lazy.py semantics notes)."""
-    from ..ops.embedding import dense_lookup
+    from ..ops.embedding import dense_lookup, narrow_ids
     from .lazy import LazyAdamState, lazy_adam_update, shared_segments
 
     from .optimizer import build_lr_schedule, schedule_value
@@ -196,7 +196,9 @@ def _make_lazy_train_step(cfg: Config, model, tx) -> Callable:
         keys = _lazy_keys(params)
         rest = {k: v for k, v in params.items() if k not in keys}
         tables = {k: params[k] for k in keys}
-        ids = batch["feat_ids"].reshape(-1, cfg.model.field_size)
+        ids = narrow_ids(batch["feat_ids"], cfg.model.feature_size,
+                         cfg.model.narrow_ids)
+        ids = ids.reshape(-1, cfg.model.field_size)
         rows = {k: dense_lookup(tables[k], ids) for k in keys}
 
         def loss_fn(rest, rows):
