@@ -1,19 +1,22 @@
 #!/bin/bash
 # Degraded-window micro-session (VERDICT r04 next-step #1): a short capture
-# (~3 min healthy, <=18 min worst-case fully-wedged) that fires on ANY
+# (~8-12 min healthy, <=55 min worst-case fully-wedged) that fires on ANY
 # successful tunnel attach — even when the full compile probe wedged — so a
-# brief or flaky window still banks the two rows the perf story needs most:
+# brief or flaky window still banks the rows the perf story needs most, in
+# value order (an early wedge keeps whatever landed before it):
 #
-#   1. transfer.py          (frames every e2e number: rig vs framework)
-#   2. spmd_scan32 @ 8192   (the PRODUCT path with scan fusion — the row
-#                            that answers the 9.6x spmd-vs-jit gap)
-#   3. jit @ 8192           (the comparator on the SAME window)
+#   1. transfer.py            (frames every e2e number: rig vs framework)
+#   2. attribution (3 points) (the round-5 question: grad_all vs
+#                              grad_all_segsum isolates the scatter cost
+#                              AND measures the shipped fix; step_spmd is
+#                              the product path under the same method)
+#   3. spmd_scan32 + jit      (the product-vs-comparator pair, fetch-timed)
 #
 # Every point is subprocess-isolated (tunnel cross-contamination,
 # docs/TPU_REPORT.md) with tight per-point timeouts: a wedged compile
-# service costs ~2.5 min here, not a full session's hours.  All persist
-# paths keep {latest, runs} history and never demote TPU data, so a later
-# full session simply refreshes these artifacts.
+# service costs one point's timeout, not a full session's hours.  All
+# persist paths keep {latest, runs} history and never demote TPU data, so
+# a later full session simply refreshes these artifacts.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 status=0
@@ -24,6 +27,13 @@ status=0
 echo "== micro: host<->device transfer (1 size, 2 reps) =="
 JAX_PLATFORMS=axon timeout 300 \
     python benchmarks/transfer.py --sizes-mb 8 --reps 2 --persist || status=1
+
+echo "== micro: step-cost attribution (the round-5 question: where do the"
+echo "   ~9-16 ms/step go?  scatter vs shard_map vs optimizer vs backward) =="
+JAX_PLATFORMS=axon timeout 1300 \
+    python benchmarks/attribution.py --batch 8192 \
+    --variants grad_all,grad_all_segsum,step_spmd \
+    --point-timeout 400 --persist || status=1
 
 echo "== micro: product path spmd_scan32 + jit comparator @ batch 8192 =="
 JAX_PLATFORMS=axon timeout 800 \

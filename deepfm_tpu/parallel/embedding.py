@@ -53,19 +53,31 @@ def sharded_lookup(
     ids: jnp.ndarray,
     *,
     axis_name: str = MODEL_AXIS,
+    table_grad: str = "scatter",
 ) -> jnp.ndarray:
     """Gather rows from a row-sharded table, inside shard_map.
 
     local_table: this shard's rows — [V/M] or [V/M, K]
     ids: global ids [B, F] (replicated across the model axis)
     returns: full rows [B, F] or [B, F, K] (replicated across the model axis)
+
+    ``table_grad="segsum"`` swaps the local gather's backward for the
+    sorted-unique-write variant (ops/embedding.py segsum_lookup) — the
+    shard-local scatter-add has the same colliding-rows pattern XLA:TPU
+    serializes on the dense path.
     """
+    from ..ops.embedding import segsum_lookup
+
     rows = local_table.shape[0]
     shard = lax.axis_index(axis_name)
     lo = shard * rows
     local_ids = ids - lo
     in_range = (local_ids >= 0) & (local_ids < rows)
-    gathered = jnp.take(local_table, jnp.clip(local_ids, 0, rows - 1), axis=0)
+    clipped = jnp.clip(local_ids, 0, rows - 1)
+    if table_grad == "segsum":
+        gathered = segsum_lookup(local_table, clipped)
+    else:
+        gathered = jnp.take(local_table, clipped, axis=0)
     mask = in_range if gathered.ndim == ids.ndim else in_range[..., None]
     gathered = jnp.where(mask, gathered, 0)
     return lax.psum(gathered, axis_name)
@@ -76,10 +88,13 @@ def sharded_l2(local_table: jnp.ndarray, axis_name: str = MODEL_AXIS) -> jnp.nda
     return 0.5 * lax.psum(jnp.sum(jnp.square(local_table)), axis_name)
 
 
-def make_sharded_lookup_fn(axis_name: str = MODEL_AXIS):
-    """A ``lookup_fn`` for model.apply, closing over the axis name."""
+def make_sharded_lookup_fn(axis_name: str = MODEL_AXIS,
+                           table_grad: str = "scatter"):
+    """A ``lookup_fn`` for model.apply, closing over the axis name and
+    gradient strategy."""
 
     def lookup(table: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
-        return sharded_lookup(table, ids, axis_name=axis_name)
+        return sharded_lookup(table, ids, axis_name=axis_name,
+                              table_grad=table_grad)
 
     return lookup

@@ -122,7 +122,7 @@ def create_retrieval_spmd_state(
 
 def _local_forward(cfg: Config, params, batch):
     """Local towers -> global item pool -> per-example CE and scores."""
-    lookup = make_sharded_lookup_fn()
+    lookup = make_sharded_lookup_fn(table_grad=cfg.model.table_grad)
     towers = apply_two_tower(
         params, batch, cfg=cfg.model, user_lookup_fn=lookup, item_lookup_fn=lookup
     )

@@ -188,14 +188,10 @@ def lazy_adam_update_shard(
 
 
 def shared_segments(flat_ids: jnp.ndarray):
-    """Precompute the sort/segment structure once for tables sharing ids."""
-    n = flat_ids.shape[0]
-    order = jnp.argsort(flat_ids)
-    sid = flat_ids[order]
-    first = jnp.concatenate([jnp.ones((1,), bool), sid[1:] != sid[:-1]])
-    seg = jnp.cumsum(first) - 1
-    row_id = jnp.zeros((n,), sid.dtype).at[seg].set(
-        sid, indices_are_sorted=True
-    )
-    valid = jnp.arange(n) < jnp.sum(first)
-    return order, seg, row_id, valid
+    """Precompute the sort/segment structure once for tables sharing ids.
+
+    Alias of ops/embedding.py ``sort_segments`` (also the segsum-backward
+    building block) — one implementation to keep in sync."""
+    from ..ops.embedding import sort_segments
+
+    return sort_segments(flat_ids)
