@@ -17,27 +17,39 @@ import numpy as np
 V_FLAGSHIP = 117_581
 
 
-def make_ctr_batches(batch_size: int, nb: int = 4, *, v: int = V_FLAGSHIP,
-                     seed: int = 0):
-    """Criteo-shaped synthetic batches (13 numeric + 26 Zipf-skewed
-    categorical), device-staged so step timing excludes the host feed."""
-    import jax
-
+def make_host_ctr_batches(batch_size: int, nb: int = 4, *,
+                          v: int = V_FLAGSHIP, seed: int = 0,
+                          ids_dtype=np.int64, lead_shape: tuple = ()):
+    """Criteo-shaped synthetic host batches (13 numeric + 26 Zipf-skewed
+    categorical) — THE synthetic distribution every harness shares.
+    ``lead_shape`` prepends stacked-scan leading dims (e.g. ``(K,)``)."""
     rng = np.random.default_rng(seed)
     out = []
     for _ in range(nb):
-        numeric = rng.integers(1, 14, size=(batch_size, 13))
-        cat = 14 + (rng.zipf(1.3, size=(batch_size, 26)) % (v - 14))
+        shp = lead_shape + (batch_size,)
+        numeric = rng.integers(1, 14, size=shp + (13,))
+        cat = 14 + (rng.zipf(1.3, size=shp + (26,)) % (v - 14))
         out.append({
-            "feat_ids": jax.device_put(np.concatenate(
-                [numeric, cat], axis=1).astype(np.int64)),
-            "feat_vals": jax.device_put(np.concatenate(
-                [rng.random((batch_size, 13), dtype=np.float32),
-                 np.ones((batch_size, 26), np.float32)], axis=1)),
-            "label": jax.device_put(
-                (rng.random(batch_size) < 0.25).astype(np.float32)),
+            "feat_ids": np.concatenate(
+                [numeric, cat], axis=-1).astype(ids_dtype),
+            "feat_vals": np.concatenate(
+                [rng.random(shp + (13,), dtype=np.float32),
+                 np.ones(shp + (26,), np.float32)], axis=-1),
+            "label": (rng.random(shp) < 0.25).astype(np.float32),
         })
     return out
+
+
+def make_ctr_batches(batch_size: int, nb: int = 4, *, v: int = V_FLAGSHIP,
+                     seed: int = 0):
+    """Device-staged variant of make_host_ctr_batches (step timing excludes
+    the host feed)."""
+    import jax
+
+    return [
+        {k: jax.device_put(vv) for k, vv in hb.items()}
+        for hb in make_host_ctr_batches(batch_size, nb, v=v, seed=seed)
+    ]
 
 
 def _is_tpu() -> bool:

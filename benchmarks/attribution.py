@@ -75,16 +75,8 @@ def _cfg(batch_size: int, *, lazy: bool = False, narrow: bool = True,
 
 
 def _stacked_host_batch(batch_size: int, ids_dtype) -> dict:
-    rng = np.random.default_rng(0)
-    numeric = rng.integers(1, 14, size=(SCAN_K, batch_size, 13))
-    cat = 14 + (rng.zipf(1.3, size=(SCAN_K, batch_size, 26)) % (V - 14))
-    return {
-        "feat_ids": np.concatenate([numeric, cat], 2).astype(ids_dtype),
-        "feat_vals": np.concatenate(
-            [rng.random((SCAN_K, batch_size, 13), dtype="float32"),
-             np.ones((SCAN_K, batch_size, 26), "float32")], 2),
-        "label": (rng.random((SCAN_K, batch_size)) < 0.25).astype("float32"),
-    }
+    return bu.make_host_ctr_batches(
+        batch_size, 1, v=V, ids_dtype=ids_dtype, lead_shape=(SCAN_K,))[0]
 
 
 def _build(variant: str, batch_size: int, narrow: bool):

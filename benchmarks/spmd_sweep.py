@@ -71,21 +71,7 @@ def _cfg(batch_size: int, lazy: bool):
 
 
 def _host_batches(batch_size: int, nb: int):
-    import numpy as np
-
-    rng = np.random.default_rng(0)
-    out = []
-    for _ in range(nb):
-        numeric = rng.integers(1, 14, size=(batch_size, 13))
-        cat = 14 + (rng.zipf(1.3, size=(batch_size, 26)) % (V - 14))
-        out.append({
-            "feat_ids": np.concatenate([numeric, cat], 1).astype("int64"),
-            "feat_vals": np.concatenate(
-                [rng.random((batch_size, 13), dtype="float32"),
-                 np.ones((batch_size, 26), "float32")], 1),
-            "label": (rng.random(batch_size) < 0.25).astype("float32"),
-        })
-    return out
+    return bu.make_host_ctr_batches(batch_size, nb, v=V)
 
 
 def _time_both(step_fn, state, batches, dispatches: int, sync_reps: int,

@@ -18,9 +18,13 @@ echo "== host<->device transfer + dispatch latency (frames every e2e number) =="
 JAX_PLATFORMS=axon timeout 900 \
     python benchmarks/transfer.py --persist || status=1
 
-echo "== step-cost attribution: fwd/bwd/scatter/optimizer/shard_map x id dtype =="
+echo "== step-cost attribution: fwd/bwd/scatter-vs-segsum/optimizer/shard_map =="
 JAX_PLATFORMS=axon timeout 3600 \
     python benchmarks/attribution.py --persist || status=1
+
+echo "== profiler trace of the product-path step (op-level attribution) =="
+JAX_PLATFORMS=axon timeout 900 \
+    python benchmarks/profile_step.py --persist || status=1
 
 echo "== PRODUCT-path sweep: jit vs spmd vs spmd_scanK (verdict r03 #1) =="
 JAX_PLATFORMS=axon timeout 3600 \

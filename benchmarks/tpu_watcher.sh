@@ -48,6 +48,7 @@ commit_with_retry() {
         docs/BENCH_MODEL_ZOO.json docs/BENCH_CONVERGENCE_DEVICE.json \
         docs/BENCH_SERVING.json docs/BENCH_SPMD_SWEEP.json \
         docs/BENCH_PALLAS_10M.json docs/BENCH_ATTRIBUTION.json \
+        docs/BENCH_PROFILE.json \
         docs/TPU_WATCHER_LOG.jsonl docs/TPU_SESSION_OUT.log \
         docs/TPU_MICRO_SESSION_OUT.log; do
         [[ -e $p ]] && paths+=("$p")

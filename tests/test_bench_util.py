@@ -1,0 +1,74 @@
+"""Timing-helper behavior (benchmarks/_bench_util.py).
+
+Round 5 converted every timed region to FETCH-based completion barriers
+(device_sync / measure_rtt) because jax.block_until_ready is racy on the
+tunneled attach.  These tests pin the helper contracts on the CPU backend
+(where device_sync falls back to block_until_ready): sync correctness on
+trees, RTT non-negativity, and time_step_loop's result schema, including
+stacked scan metrics.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks"))
+
+import _bench_util as bu  # noqa: E402
+
+
+def test_device_sync_handles_trees_and_empties():
+    bu.device_sync({})
+    bu.device_sync([])
+    bu.device_sync(jnp.ones(3))
+    bu.device_sync({"a": jnp.ones(3), "b": [jnp.zeros(())]})
+    bu.device_sync_all([{"x": jnp.ones((2, 2))}, {"x": jnp.ones((2, 2))}])
+
+
+def test_device_sync_large_leaf_path():
+    # >4096 elements exercises the single-element-fetch branch on TPU;
+    # on CPU it must still simply complete
+    bu.device_sync(jnp.ones((100, 100)))
+
+
+def test_measure_rtt_small_nonnegative():
+    x = jnp.ones((4,))
+    rtt = bu.measure_rtt(x)
+    assert 0 <= rtt < 1.0  # CPU: effectively instant
+
+
+def test_time_step_loop_schema_single_and_stacked():
+    def step(state, batch):
+        state = state + jnp.sum(batch["label"]) * 0
+        return state, {"loss": jnp.mean(batch["label"]) + state * 0}
+
+    jit_step = jax.jit(step)
+    batches = [{"label": jnp.ones((8,)) * i} for i in range(3)]
+    r = bu.time_step_loop(jit_step, jnp.zeros(()), batches, steps=5,
+                          batch_size=8)
+    assert set(r) >= {"examples_per_sec", "step_us", "sync_rtt_ms",
+                      "final_loss"}
+    assert r["examples_per_sec"] > 0
+
+    # stacked [K] metrics (scan variants): final_loss is the last sub-step
+    def scan_step(state, batch):
+        return state, {"loss": jnp.arange(4.0)}
+
+    r2 = bu.time_step_loop(jax.jit(scan_step), jnp.zeros(()), batches,
+                           steps=2, batch_size=32)
+    assert r2["final_loss"] == 3.0
+
+
+def test_rescale_schedule_clamps_tiny_horizons():
+    out = bu.rescale_schedule(
+        {"lr_schedule": "cosine", "warmup_steps": 500, "decay_steps": 9999},
+        steps=50)
+    assert out["warmup_steps"] < out["decay_steps"] == 50
+    # constant schedules pass through untouched
+    const = {"lr_schedule": "constant", "learning_rate": 1.0}
+    assert bu.rescale_schedule(const, steps=50) is const
