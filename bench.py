@@ -31,6 +31,10 @@ Measured variants:
   spmd_scan8    the product path with run.steps_per_loop=8: K steps fused
                 into one scanned dispatch + one stacked transfer
   spmd_scan32   same with K=32 — the deep-amortization headline config
+  *_segsum      same step with table_grad='segsum' (sorted-unique-write
+                embedding-gradient backward, ops/embedding.py — the round-5
+                candidate fix for the serialized scatter); measured right
+                after its scatter twin so short windows still decide it
 """
 
 from __future__ import annotations
@@ -145,7 +149,8 @@ def dense_adam_roofline(platform: str, device_kind: str = "") -> dict:
     return roof
 
 
-def _flagship_cfg(fused: str = "off", lazy: bool = False):
+def _flagship_cfg(fused: str = "off", lazy: bool = False,
+                  table_grad: str = "scatter"):
     from deepfm_tpu.core.config import Config
 
     return Config.from_dict(
@@ -157,6 +162,7 @@ def _flagship_cfg(fused: str = "off", lazy: bool = False):
                 "deep_layers": DEEP,
                 "dropout_keep": (0.5, 0.5, 0.5),
                 "fused_kernel": fused,
+                "table_grad": table_grad,
             },
             "optimizer": {"learning_rate": 0.0005,
                           "lazy_embedding_updates": lazy},
@@ -166,25 +172,14 @@ def _flagship_cfg(fused: str = "off", lazy: bool = False):
 
 
 def _synth_batches(batch_size: int, nb: int = 8, device_put: bool = True):
-    """Synthetic Criteo-shaped batches (13 numeric + 26 skewed categorical),
-    pre-staged on device so the bench isolates the training-step rate."""
-    import jax
+    """Synthetic Criteo-shaped batches (the shared generator in
+    _bench_util), pre-staged on device so the bench isolates the
+    training-step rate."""
+    import _bench_util as bu
 
-    rng = np.random.default_rng(0)
-    out = []
-    for _ in range(nb):
-        numeric = rng.integers(1, 14, size=(batch_size, 13))
-        cat = 14 + (rng.zipf(1.3, size=(batch_size, 26)) % (V - 14))
-        ids = np.concatenate([numeric, cat], axis=1).astype(np.int64)
-        vals = np.concatenate(
-            [rng.random((batch_size, 13), dtype=np.float32),
-             np.ones((batch_size, 26), dtype=np.float32)], axis=1
-        )
-        labels = (rng.random(batch_size) < 0.25).astype(np.float32)
-        hb = {"feat_ids": ids, "feat_vals": vals, "label": labels}
-        out.append({k: jax.device_put(v) for k, v in hb.items()}
-                   if device_put else hb)
-    return out
+    if device_put:
+        return bu.make_ctr_batches(batch_size, nb, v=V)
+    return bu.make_host_ctr_batches(batch_size, nb, v=V)
 
 
 STEPS = 100
@@ -205,18 +200,20 @@ def _time_loop(step_fn, state, bs) -> tuple[float, float]:
     return r["examples_per_sec"], r["final_loss"]
 
 
-def measure(fused: str, lazy: bool = False) -> tuple[float, float]:
+def measure(fused: str, lazy: bool = False,
+            table_grad: str = "scatter") -> tuple[float, float]:
     import jax
 
     from deepfm_tpu.train import create_train_state, make_train_step
 
-    c = _flagship_cfg(fused, lazy)
+    c = _flagship_cfg(fused, lazy, table_grad)
     state = create_train_state(c)
     train_step = jax.jit(make_train_step(c), donate_argnums=(0,))
     return _time_loop(train_step, state, _synth_batches(BATCH))
 
 
-def measure_spmd(lazy: bool, steps_per_loop: int = 1) -> tuple[float, float]:
+def measure_spmd(lazy: bool, steps_per_loop: int = 1,
+                 table_grad: str = "scatter") -> tuple[float, float]:
     """The product path: shard_map step on a [1,1] mesh — measures the
     shard_map/collective overhead vs the plain jit step.  With
     ``steps_per_loop > 1``, K optimizer steps fuse into one scanned dispatch
@@ -227,7 +224,7 @@ def measure_spmd(lazy: bool, steps_per_loop: int = 1) -> tuple[float, float]:
         make_spmd_train_step, shard_batch, shard_batch_stacked,
     )
 
-    c = _flagship_cfg("off", lazy).with_overrides(
+    c = _flagship_cfg("off", lazy, table_grad).with_overrides(
         mesh={"data_parallel": 1, "model_parallel": 1},
     )
     mesh = build_mesh(MeshConfig(data_parallel=1, model_parallel=1))
@@ -253,16 +250,23 @@ def measure_spmd(lazy: bool, steps_per_loop: int = 1) -> tuple[float, float]:
     return _time_loop(step_fn, state, sb)
 
 
+# ordered by information value under the time budget: each scatter variant
+# is immediately followed by its segsum twin (ops/embedding.py segsum_lookup
+# — the round-5 candidate fix for the serialized table-grad scatter), so a
+# short window still yields the comparison that decides table_grad's default
 VARIANTS = {
     "xla": lambda: measure("off"),
-    "pallas_fused": lambda: measure("on", False),
+    "xla_segsum": lambda: measure("off", table_grad="segsum"),
+    # the product path with deep dispatch amortization — the headline
+    # run.steps_per_loop configuration (full K sweep: benchmarks/spmd_sweep.py)
+    "spmd_scan32": lambda: measure_spmd(False, steps_per_loop=32),
+    "spmd_scan32_segsum": lambda: measure_spmd(
+        False, steps_per_loop=32, table_grad="segsum"),
     "lazy_adam": lambda: measure("off", True),
     "spmd_xla": lambda: measure_spmd(False),
     "spmd_lazy": lambda: measure_spmd(True),
     "spmd_scan8": lambda: measure_spmd(False, steps_per_loop=8),
-    # the product path with deep dispatch amortization — the headline
-    # run.steps_per_loop configuration (full K sweep: benchmarks/spmd_sweep.py)
-    "spmd_scan32": lambda: measure_spmd(False, steps_per_loop=32),
+    "pallas_fused": lambda: measure("on", False),
 }
 
 
