@@ -38,10 +38,18 @@ import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 from jax import lax  # noqa: E402
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
-from jax import shard_map  # noqa: E402
+from deepfm_tpu.core.compat import shard_map
 
 
 def _time(fn, *args, iters=20):
+    """Returns (corrected, uncorrected) seconds/iter.
+
+    The corrected value subtracts the measured per-iteration sync RTT; the
+    uncorrected value is the raw wall time.  BOTH are reported so the RTT
+    subtraction can never silently bias a collective time low (e.g. an RTT
+    estimate polluted by a transient stall would make `ms` optimistic —
+    `ms_uncorrected` bounds the truth from above; attribution.py's
+    two-point slope method is the cross-check for suspicious rows)."""
     import _bench_util as bu
 
     out = fn(*args)
@@ -56,8 +64,9 @@ def _time(fn, *args, iters=20):
         # value FETCH (block_until_ready is racy on the tunneled attach)
         # whose per-iteration RTT is measured above and subtracted
         bu.device_sync(out)
-    dt = max(time.perf_counter() - t0 - rtt * iters, 1e-9)
-    return dt / iters
+    raw = time.perf_counter() - t0
+    dt = max(raw - rtt * iters, 1e-9)
+    return dt / iters, raw / iters
 
 
 def bench_collectives(mesh: Mesh, size_mb: float, iters: int) -> list[dict]:
@@ -111,10 +120,11 @@ def bench_collectives(mesh: Mesh, size_mb: float, iters: int) -> list[dict]:
     }
     for name, (fn, bytes_moved, bytes_copied) in cases.items():
         jfn = jax.jit(fn)
-        dt = _time(jfn, sharded, iters=iters)
+        dt, dt_raw = _time(jfn, sharded, iters=iters)
         results.append({
             "collective": name, "devices": n, "mb": round(elems * 4 / 1e6, 2),
             "ms": round(dt * 1e3, 4),
+            "ms_uncorrected": round(dt_raw * 1e3, 4),
             "algo_gbps": round(bytes_moved / dt / 1e9, 3),
             "copy_gbps": round(bytes_copied / dt / 1e9, 3),
         })
@@ -139,10 +149,11 @@ def bench_sharded_lookup(mesh: Mesh, iters: int) -> dict:
         lambda t, i: sharded_lookup(t, i, axis_name="model"),
         mesh=mesh, in_specs=(P("model"), P()), out_specs=P(),
     ))
-    dt = _time(fn, table, ids, iters=iters)
+    dt, dt_raw = _time(fn, table, ids, iters=iters)
     return {
         "collective": "sharded_embedding_lookup", "devices": n,
         "rows": b * f, "k": k, "ms": round(dt * 1e3, 4),
+        "ms_uncorrected": round(dt_raw * 1e3, 4),
         "lookups_per_sec": round(b * f / dt, 1),
     }
 
@@ -216,15 +227,18 @@ def bench_lazy_composite(iters: int) -> dict | None:
             out_specs=(P(None), P(None)),  # replicated gathered stream
             check_vma=False,
         ))
-        dt_full = _time(full, table, m, v, ids_sh, g_sh, iters=iters)
-        dt_ag = _time(ag, ids_sh, g_sh, iters=iters)
+        dt_full, dt_full_raw = _time(full, table, m, v, ids_sh, g_sh,
+                                     iters=iters)
+        dt_ag, dt_ag_raw = _time(ag, ids_sh, g_sh, iters=iters)
     gathered_bytes = B * F * (4 + K * 4)
     return {
         "collective": "lazy_update_composite",
         "devices": int(devices.size), "mesh": f"data={dp} x model={mp}",
         "batch": B, "fields": F, "k": K, "vocab": V,
         "ms": round(dt_full * 1e3, 4),
+        "ms_uncorrected": round(dt_full_raw * 1e3, 4),
         "all_gather_ms": round(dt_ag * 1e3, 4),
+        "all_gather_ms_uncorrected": round(dt_ag_raw * 1e3, 4),
         "all_gather_fraction": round(dt_ag / dt_full, 3),
         "gathered_mb_per_step": round(gathered_bytes / 1e6, 2),
         "rows_updated_per_sec": round(B * F / dt_full, 1),

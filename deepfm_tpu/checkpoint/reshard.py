@@ -32,9 +32,65 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..train.step import TrainState
 from .ckpt import Checkpointer
+
+# sharding -> verdict of _reshape_under_sharding_ok (one tiny probe compile
+# per distinct (mesh, spec) pair per process)
+_RESHAPE_PROBE_CACHE: dict = {}
+
+
+def _reshape_under_sharding_ok(sharding) -> bool:
+    """Probe whether jitted row-reshapes with ``out_shardings=sharding``
+    are value-correct on this backend.
+
+    Some XLA:CPU builds (observed on jaxlib 0.4.36's 8-virtual-device
+    mesh) MISCOMPILE ``concatenate``/slice under an ``out_shardings`` whose
+    mesh has a replicated axis: the replicated output is assembled by
+    SUMMING partial shards, silently doubling every value.  Restoring a
+    checkpoint across topologies would corrupt the tables, so the jitted
+    streaming reshape is only used after this tiny probe proves it honest;
+    otherwise the adapt falls back to a host-staged pad/slice (correct
+    everywhere, O(leaf) host memory — acceptable on the small backends
+    that exhibit the bug)."""
+    key = (sharding.mesh, sharding.spec)
+    hit = _RESHAPE_PROBE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    # dim0 divisible by any axis product; dim1 broadcastable for 1-D specs
+    rows = 8
+    for name in sharding.mesh.axis_names:
+        rows *= sharding.mesh.shape[name]
+    probe = np.arange(1, rows + 1, dtype=np.float32)
+    try:
+        cat = jax.jit(
+            lambda a: jnp.concatenate([a[: rows // 2], a[: rows // 2]]),
+            out_shardings=jax.sharding.NamedSharding(
+                sharding.mesh, jax.sharding.PartitionSpec(
+                    *(sharding.spec[:1] or [None])
+                )
+            ),
+        )(jnp.asarray(probe))
+        want = np.concatenate([probe[: rows // 2], probe[: rows // 2]])
+        # verify per ADDRESSABLE shard, not via a full device_get: on a
+        # multi-host mesh fetching the whole output raises for
+        # addressability, which says nothing about value-correctness —
+        # a blanket fetch would route every multi-host restore onto the
+        # O(full-leaf) host-staged fallback exactly where it can't afford
+        # to.  The summed-shard miscompile corrupts local shards too, so
+        # the local view is a sufficient witness.
+        ok = all(
+            np.array_equal(np.asarray(s.data), want[s.index])
+            for s in cat.addressable_shards
+        )
+    except Exception:
+        # compile/execute failure: the jitted streaming path would fail
+        # identically, so falling back is correct (not just cautious)
+        ok = False
+    _RESHAPE_PROBE_CACHE[key] = ok
+    return ok
 
 # mirror parallel/spmd.TABLE_KEYS without importing (keeps this module free
 # of the parallel -> models import chain at import time)
@@ -187,16 +243,26 @@ def restore_resharded(
                     f"data — the target feature_size is smaller than the "
                     f"checkpoint's true vocabulary"
                 )
-            return jax.jit(
-                lambda a: a[:rows_t], out_shardings=sharding
-            )(saved)
+            if _reshape_under_sharding_ok(sharding):
+                return jax.jit(
+                    lambda a: a[:rows_t], out_shardings=sharding
+                )(saved)
+            return jax.device_put(
+                np.asarray(jax.device_get(saved))[:rows_t], sharding
+            )
         pad = rows_t - rows_s
-        return jax.jit(
-            lambda a: jnp.concatenate(
-                [a, jnp.zeros((pad, *a.shape[1:]), a.dtype)]
-            ),
-            out_shardings=sharding,
-        )(saved)
+        if _reshape_under_sharding_ok(sharding):
+            return jax.jit(
+                lambda a: jnp.concatenate(
+                    [a, jnp.zeros((pad, *a.shape[1:]), a.dtype)]
+                ),
+                out_shardings=sharding,
+            )(saved)
+        host = np.asarray(jax.device_get(saved))
+        host = np.concatenate(
+            [host, np.zeros((pad, *host.shape[1:]), host.dtype)]
+        )
+        return jax.device_put(host, sharding)
 
     adapted = jax.tree_util.tree_map_with_path(
         adapt, raw, target_dict, shard_dict

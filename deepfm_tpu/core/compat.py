@@ -1,0 +1,30 @@
+"""jax version compatibility shims.
+
+The framework targets current jax, where ``shard_map`` is a top-level
+export and its replication-checking knob is ``check_vma``.  Older jaxlibs
+(0.4.x) keep ``shard_map`` under ``jax.experimental.shard_map`` and call
+the same knob ``check_rep``.  Importing from here gives every caller one
+spelling that works on both:
+
+    from deepfm_tpu.core.compat import shard_map
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # jax < 0.6 keeps it in the experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+
+    @functools.wraps(_shard_map)
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(*args, **kwargs)

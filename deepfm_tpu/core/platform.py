@@ -39,6 +39,72 @@ def host_cpu_count() -> int:
         return os.cpu_count() or 1
 
 
+def xla_flags_supported(flags: str) -> bool:
+    """True when this jaxlib accepts ``flags`` in XLA_FLAGS.
+
+    jaxlib HARD-ABORTS the whole process (``F parse_flags_from_env:
+    Unknown flags in XLA_FLAGS``) at first backend init when XLA_FLAGS
+    names a flag the bundled XLA doesn't know — e.g. the CPU collective
+    watchdog flags on jaxlib < 0.5.  Probing must therefore happen in a
+    THROWAWAY subprocess; the verdict is cached on disk per (jaxlib
+    version, flags) so the ~2 s probe runs once per machine, not once per
+    pytest session."""
+    import hashlib
+    import subprocess
+    import sys
+    import tempfile
+
+    try:
+        import jaxlib.version
+
+        version = jaxlib.version.__version__
+    except Exception:
+        version = "unknown"
+    key = hashlib.sha1(f"{version}|{flags}".encode()).hexdigest()[:16]
+    cache = os.path.join(tempfile.gettempdir(), f".xla_flag_probe_{key}")
+    try:
+        with open(cache) as f:
+            return f.read().strip() == "1"
+    except OSError:
+        pass
+    # mirror sanitize_backend inside the probe: the ambient sitecustomize
+    # may register a tunneled PJRT plugin whose attach blocks even under
+    # JAX_PLATFORMS=cpu — deregister it before touching devices
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "try:\n"
+        "    from jax._src import xla_bridge as _xb\n"
+        "    for _p in ('axon',):\n"
+        "        _xb._backend_factories.pop(_p, None)\n"
+        "except Exception:\n"
+        "    pass\n"
+        "jax.devices()\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS=flags)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=env,
+            capture_output=True, timeout=120,
+        )
+    except Exception:
+        # timeout / spawn failure: transient, NOT evidence about the
+        # flags — report unsupported for this call but leave the cache
+        # empty so a healthy later run can still enable the watchdogs
+        return False
+    ok = proc.returncode == 0
+    # cache only definitive verdicts: success, or the known unknown-flag
+    # fatal abort.  Any other nonzero exit (OOM, env breakage) says
+    # nothing about flag support and must not poison the cache.
+    if ok or b"Unknown flags in XLA_FLAGS" in proc.stderr:
+        try:
+            with open(cache, "w") as f:
+                f.write("1" if ok else "0")
+        except OSError:
+            pass
+    return ok
+
+
 def relax_cpu_collective_timeouts(
     warn_s: int = 120, terminate_s: int = 900
 ) -> None:
@@ -48,7 +114,9 @@ def relax_cpu_collective_timeouts(
     mesh topology — a long first-compile or a heavy step can keep one
     device thread away from a rendezvous past 40 s and XLA kills the
     process mid-training.  Call BEFORE the first jax backend init; no-op
-    for flags the caller already set explicitly."""
+    for flags the caller already set explicitly, and for a jaxlib that
+    doesn't know these flags (older XLA both lacks them and would
+    fatal-abort on the unknown names — see :func:`xla_flags_supported`)."""
     flags = os.environ.get("XLA_FLAGS", "")
     add = []
     if "xla_cpu_collective_call_warn_stuck_timeout_seconds" not in flags:
@@ -59,7 +127,7 @@ def relax_cpu_collective_timeouts(
         add.append(
             f"--xla_cpu_collective_call_terminate_timeout_seconds={terminate_s}"
         )
-    if add:
+    if add and xla_flags_supported(" ".join(add)):
         os.environ["XLA_FLAGS"] = " ".join([flags] + add).strip()
 
 
@@ -70,6 +138,15 @@ def sanitize_backend() -> None:
     try:
         import jax
 
+        # value-stable RNG regardless of output sharding: jax < 0.5
+        # defaults this off, making jit(init, out_shardings=sharded)
+        # produce different table values than dense init — the framework
+        # assumes the (newer-jax default) partitionable threefry everywhere
+        # sharded-vs-dense parity matters
+        try:
+            jax.config.update("jax_threefry_partitionable", True)
+        except Exception:
+            pass
         if requested:
             # effective even if jax was imported (and env read) earlier
             jax.config.update("jax_platforms", requested)

@@ -25,9 +25,17 @@ def narrow_ids(ids, vocab_size: int, enabled: bool = True):
     device transfer — halves the id bytes moved) and on traced/device
     arrays (a cheap elementwise op XLA fuses away).  No-op for int32 input,
     an int32-unsafe vocabulary, or ``enabled=False``
-    (``ModelConfig.narrow_ids``, the ablation switch)."""
+    (``ModelConfig.narrow_ids``, the ablation switch).
+
+    The dense path does NOT validate ids before this cast (train/step.py
+    feeds raw batch ids straight in), so a stray id >= 2**31 would WRAP
+    under a bare ``astype(int32)`` and land on an arbitrary in-range row.
+    Ids are therefore clipped to ``[0, vocab_size - 1]`` before casting —
+    exactly the row the downstream clip-mode gather (``dense_lookup``)
+    would have produced for the original int64 value, so the cast stays a
+    pure representation change for every input."""
     if enabled and ids.dtype == np.int64 and vocab_size <= _INT32_MAX_ROWS:
-        return ids.astype(np.int32)
+        return ids.clip(0, vocab_size - 1).astype(np.int32)
     return ids
 
 
@@ -97,11 +105,29 @@ def _segsum_bwd(meta, ids, g):
     # one write per UNIQUE row; empty segments target distinct out-of-range
     # rows (rows + position) so the index vector stays sorted AND unique —
     # XLA can emit a vectorized scatter instead of a serialized one
-    write = jnp.where(valid, row_id, rows + jnp.arange(n, dtype=row_id.dtype))
-    grad = jnp.zeros((rows,) + tail, dtype).at[write].add(
-        summed.astype(dtype), indices_are_sorted=True, unique_indices=True,
-        mode="drop",
-    )
+    if rows + n - 1 <= jnp.iinfo(row_id.dtype).max:
+        write = jnp.where(
+            valid, row_id, rows + jnp.arange(n, dtype=row_id.dtype)
+        )
+        grad = jnp.zeros((rows,) + tail, dtype).at[write].add(
+            summed.astype(dtype), indices_are_sorted=True,
+            unique_indices=True, mode="drop",
+        )
+    else:
+        # the sentinel run rows..rows+n-1 would overflow the id dtype, and
+        # NO out-of-range sentinel is representable at all: .at[] wraps
+        # negative indices python-style (mode="drop" only drops >= rows,
+        # it does not drop negatives).  So route invalid segments at row 0
+        # and zero their contributions EXPLICITLY — segment_sum already
+        # leaves empty segments at 0, but masking here keeps correctness
+        # independent of that invariant.  Forfeits the sorted+unique
+        # scatter hint; only reachable when the table ends within B*F
+        # rows of the dtype max, so the slow scatter is a non-issue.
+        mask = valid.reshape((n,) + (1,) * len(tail))
+        write = jnp.where(valid, row_id, jnp.array(0, row_id.dtype))
+        grad = jnp.zeros((rows,) + tail, dtype).at[write].add(
+            jnp.where(mask, summed.astype(dtype), 0), mode="drop",
+        )
     import numpy as _np
 
     return grad, _np.zeros(ids.shape, jax.dtypes.float0)

@@ -71,6 +71,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax < 0.5 spells the unconstrained/off-chip memory space ANY; newer jax
+# added the explicit HBM alias this kernel targets
+_HBM = getattr(pltpu, "HBM", pltpu.ANY)
+
 _LANES = 128
 _N_TILE = 1024          # gathered rows per grid step
 _NSEM = 64              # DMA pipeline depth (copies in flight)
@@ -216,7 +220,7 @@ def _gather_unique(fm_v, win, sel, first, dist, dma_rows, *, interpret: bool):
         in_specs=[
             pl.BlockSpec((_N_TILE, 1), lambda i, *_: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((_N_TILE, 1), lambda i, *_: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=_HBM),
         ],
         out_specs=pl.BlockSpec(
             (_N_TILE, k), lambda i, *_: (i, 0), memory_space=pltpu.VMEM

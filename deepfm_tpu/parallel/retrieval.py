@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 import optax
 from jax import lax
-from jax import shard_map
+from ..core.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.config import Config

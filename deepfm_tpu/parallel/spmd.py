@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ..core.compat import shard_map
 
 from ..core.config import Config
 from ..models.base import get_model

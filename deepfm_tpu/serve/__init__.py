@@ -1,5 +1,7 @@
+from .batcher import MicroBatcher, OverloadedError  # noqa: F401
 from .export import (  # noqa: F401
     export_servable,
+    load_batching_servable,
     load_retrieval_servable,
     load_servable,
     write_predictions,

@@ -104,6 +104,35 @@ def load_servable(directory: str | os.PathLike) -> tuple[Callable, Config]:
     return predict, cfg
 
 
+def load_batching_servable(
+    directory: str | os.PathLike,
+    *,
+    buckets: tuple[int, ...] = (8, 32, 128, 512),
+    max_wait_ms: float = 2.0,
+    max_queue_rows: int | None = None,
+    precompile: bool = True,
+):
+    """Load a CTR servable wrapped in the micro-batching engine.
+
+    Returns ``(MicroBatcher, Config)`` — the servable's jitted predict
+    closure behind the dynamic batcher (serve/batcher.py): concurrent
+    ``score`` calls coalesce into padded bucket shapes, each bucket one
+    XLA executable, all compiled here (``precompile=True``) so the first
+    live request never pays a compile.  This is the embeddable form of
+    what ``serve_forever`` runs behind HTTP.
+    """
+    from .batcher import MicroBatcher
+
+    predict, cfg = load_servable(directory)
+    batcher = MicroBatcher(
+        predict, cfg.model.field_size, buckets=buckets,
+        max_wait_ms=max_wait_ms, max_queue_rows=max_queue_rows,
+    )
+    if precompile:
+        batcher.precompile()
+    return batcher, cfg
+
+
 def load_retrieval_servable(
     directory: str | os.PathLike,
 ) -> tuple[Callable, Callable, Config]:

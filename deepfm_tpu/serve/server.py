@@ -26,9 +26,12 @@ artifact, with zero extra dependencies:
   top-k corpus ids + scores per user query — the dual-encoder deployment
   pattern (query encoding online, corpus offline).
 
-Requests are scored through the jitted servable ``predict`` closure
-(serve/export.py); inputs are padded to a fixed batch size so XLA compiles
-ONE executable instead of one per request size.
+Requests are scored through the dynamic micro-batching engine
+(serve/batcher.py): concurrent requests coalesce into padded power-of-two
+buckets (``--buckets``), each bucket a precompiled XLA executable, with an
+admission timeout (``--max-wait-ms``) and bounded-queue backpressure
+(503 on overload).  ``GET /v1/metrics`` exposes request counts, the
+batch-size histogram, queue depth, and p50/p95/p99 latency.
 
     python -m deepfm_tpu.serve.server --servable /path/servable --port 8501
     cat batch.libsvm | python -m deepfm_tpu.serve.server --servable D --stdin
@@ -44,6 +47,21 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
 import numpy as np
+
+from .batcher import (
+    MicroBatcher,
+    OverloadedError,
+    check_features,
+    instances_to_arrays,
+)
+
+_check_features = check_features
+
+
+def _parse_buckets(s) -> tuple[int, ...]:
+    if isinstance(s, str):
+        return tuple(int(x) for x in s.split(",") if x.strip())
+    return tuple(int(x) for x in s)
 
 
 def _apply_fixed_batch(
@@ -73,24 +91,17 @@ def _apply_fixed_batch(
     return out
 
 
-def _instances_to_arrays(instances: list[dict]) -> tuple[np.ndarray, np.ndarray]:
-    ids = np.asarray([inst["feat_ids"] for inst in instances], np.int64)
-    vals = np.asarray([inst["feat_vals"] for inst in instances], np.float32)
-    return ids, vals
-
-
-def _check_features(ids: np.ndarray, vals: np.ndarray, fields: int) -> None:
-    """Reject malformed [N, F] pairs with one shared message shape."""
-    if ids.ndim != 2 or ids.shape[1] != fields:
-        raise ValueError(f"expected [N, {fields}] features, got {ids.shape}")
-    if vals.shape != ids.shape:
-        raise ValueError(
-            f"feat_vals shape {vals.shape} != feat_ids shape {ids.shape}"
-        )
+_instances_to_arrays = instances_to_arrays
 
 
 class Scorer:
-    """Fixed-batch wrapper over the servable predict closure."""
+    """Fixed-batch wrapper over the servable predict closure.
+
+    This is the pre-batcher single-lock engine: every request serializes
+    behind one lock and chunks through ONE fixed padded shape.  Kept as
+    the baseline the micro-batching engine is benchmarked against
+    (benchmarks/serving.py) — production serving goes through
+    :class:`deepfm_tpu.serve.batcher.MicroBatcher`."""
 
     def __init__(self, predict: Callable, field_size: int, batch_size: int = 256):
         self._predict = predict
@@ -109,132 +120,42 @@ class Scorer:
         return self.score(*_instances_to_arrays(instances))
 
 
-class OverloadedError(RuntimeError):
-    """Queue depth exceeded: the server sheds load instead of growing an
-    unbounded backlog (mapped to HTTP 503 by the handler)."""
-
-
-class BatchingScorer:
-    """Cross-request micro-batching front (the TF-Serving batching-config
-    role).  Round-3 measurement: the HTTP layer served batch-1 requests at
-    12× the scorer's cost because every request paid its own dispatch
-    behind the scorer lock (`docs/BENCH_SERVING.json`).  Here concurrent
-    requests coalesce by BACKPRESSURE, with zero added idle latency: a
-    worker thread drains everything queued, stacks it into one fixed-batch
-    dispatch, and fans the slices back.  A lone request dispatches
-    immediately (worker idle -> drains a queue of one); requests arriving
-    while the device is busy pile up and share the next dispatch.
-
-    The queue is bounded (``max_queue_rows``, default 16 dispatches worth):
-    beyond it callers get :class:`OverloadedError` → 503, so sustained
-    overload sheds slow clients instead of growing memory and latency
-    without bound.
-
-    Same interface as Scorer; shape validation happens on the caller's
-    thread so a malformed request fails alone, never poisoning a batch.
-    """
-
-    def __init__(self, scorer: Scorer, max_rows_per_dispatch: int = 4096,
-                 max_queue_rows: int | None = None):
-        import collections
-
-        self._scorer = scorer
-        self._max_rows = max_rows_per_dispatch
-        self._max_queue_rows = (
-            16 * max_rows_per_dispatch if max_queue_rows is None
-            else max_queue_rows
-        )
-        self._cond = threading.Condition()
-        self._queue: "collections.deque[dict]" = collections.deque()
-        self._queued_rows = 0
-        self._worker = threading.Thread(target=self._run, daemon=True)
-        self._worker.start()
-
-    def score(self, ids: np.ndarray, vals: np.ndarray) -> np.ndarray:
-        ids = np.asarray(ids, np.int64)
-        vals = np.asarray(vals, np.float32)
-        # full pair validation HERE, on the caller's thread: a malformed
-        # request (including a vals/ids mismatch) must fail alone, never
-        # reach the shared queue, and never skew another caller's offsets
-        _check_features(ids, vals, self._scorer._fields)
-        if ids.shape[0] == 0:
-            return np.zeros((0,), np.float32)
-        item = {"ids": ids, "vals": vals, "done": threading.Event()}
-        with self._cond:
-            # the bound sheds BACKLOG, not request size: a single request
-            # bigger than the bound is admitted when the queue is empty
-            # (it chunks through the fixed batch) — rejecting it would
-            # lock large-batch clients out forever on an idle server
-            if (self._queued_rows > 0
-                    and self._queued_rows + ids.shape[0]
-                    > self._max_queue_rows):
-                raise OverloadedError(
-                    f"scoring queue full ({self._queued_rows} rows "
-                    f">= {self._max_queue_rows}); retry later"
-                )
-            self._queue.append(item)
-            self._queued_rows += ids.shape[0]
-            self._cond.notify()
-        item["done"].wait()
-        if "error" in item:
-            raise item["error"]
-        return item["result"]
-
-    def score_instances(self, instances: list[dict]) -> np.ndarray:
-        return self.score(*_instances_to_arrays(instances))
-
-    def _run(self) -> None:
-        while True:
-            with self._cond:
-                while not self._queue:
-                    self._cond.wait()
-                batch, rows = [], 0
-                while self._queue and rows < self._max_rows:
-                    batch.append(self._queue.popleft())
-                    rows += batch[-1]["ids"].shape[0]
-                self._queued_rows -= rows
-            try:
-                probs = self._scorer.score(
-                    np.concatenate([b["ids"] for b in batch]),
-                    np.concatenate([b["vals"] for b in batch]),
-                )
-                off = 0
-                for b in batch:
-                    n = b["ids"].shape[0]
-                    b["result"] = probs[off : off + n]
-                    off += n
-            except Exception as e:  # runtime failure: fail the whole batch
-                for b in batch:
-                    b["error"] = e
-            finally:
-                for b in batch:
-                    b["done"].set()
-
-
 class RetrievalScorer:
     """Two-tower serving: encode either side; top-k retrieve against a
     pre-encoded item corpus (the dual-encoder deployment pattern — query
-    encoding online, corpus encoded at startup for scoring/ANN)."""
+    encoding online, corpus encoded at startup for scoring/ANN).
+
+    Each tower gets its own micro-batching engine (separate field widths,
+    separate bucket executables), so concurrent user- and item-encode
+    traffic coalesces independently."""
 
     def __init__(self, encode_user: Callable, encode_item: Callable,
-                 cfg, batch_size: int = 256):
-        self._enc = {"user": encode_user, "item": encode_item}
-        self._fields = {
-            "user": cfg.model.user_field_size,
-            "item": cfg.model.item_field_size,
+                 cfg, buckets=(8, 32, 128, 512), max_wait_ms: float = 2.0,
+                 max_queue_rows: int | None = None):
+        self._batchers = {
+            "user": MicroBatcher(
+                encode_user, cfg.model.user_field_size, buckets=buckets,
+                max_wait_ms=max_wait_ms, max_queue_rows=max_queue_rows,
+                name="encode_user",
+            ),
+            "item": MicroBatcher(
+                encode_item, cfg.model.item_field_size, buckets=buckets,
+                max_wait_ms=max_wait_ms, max_queue_rows=max_queue_rows,
+                name="encode_item",
+            ),
         }
-        self._batch = batch_size
-        self._lock = threading.Lock()
         self._corpus_ids: np.ndarray | None = None
         self._corpus_emb: np.ndarray | None = None
 
+    def precompile(self) -> dict:
+        return {s: b.precompile() for s, b in self._batchers.items()}
+
+    def metrics_snapshot(self) -> dict:
+        return {s: b.metrics_snapshot() for s, b in self._batchers.items()}
+
     def encode(self, side: str, ids: np.ndarray, vals: np.ndarray) -> np.ndarray:
         try:
-            return _apply_fixed_batch(
-                self._enc[side], ids, vals,
-                fields=self._fields[side], batch_size=self._batch,
-                lock=self._lock,
-            )
+            return self._batchers[side].score(ids, vals)
         except ValueError as e:
             raise ValueError(f"{side}: {e}") from None
 
@@ -302,6 +223,11 @@ def make_retrieval_handler(scorer: RetrievalScorer, model_name: str):
                         ),
                     },
                 )
+            elif self.path == "/v1/metrics":
+                self._send(
+                    200,
+                    {"model": model_name, **scorer.metrics_snapshot()},
+                )
             else:
                 self._send(404, {"error": f"unknown path {self.path!r}"})
 
@@ -338,6 +264,8 @@ def make_retrieval_handler(scorer: RetrievalScorer, model_name: str):
                             "scores": scores.tolist(),
                         },
                     )
+            except OverloadedError as e:
+                self._send(503, {"error": str(e)})
             except (ValueError, KeyError, TypeError) as e:
                 self._send(400, {"error": f"{type(e).__name__}: {e}"})
             except Exception as e:
@@ -384,7 +312,11 @@ def _send_json(self, code: int, payload: dict) -> None:
     self.wfile.write(body)
 
 
-def make_handler(scorer: Scorer, model_name: str):
+def make_handler(scorer, model_name: str):
+    """REST handler over any engine exposing score/score_instances —
+    the micro-batching engine in production; the single-lock Scorer only
+    in the benchmark baseline.  ``GET /v1/metrics`` serves the engine's
+    metrics snapshot when the engine provides one."""
     predict_path = f"/v1/models/{model_name}:predict"
     binary_path = f"/v1/models/{model_name}:predict_binary"
     status_path = f"/v1/models/{model_name}"
@@ -409,6 +341,11 @@ def make_handler(scorer: Scorer, model_name: str):
                             {"version": "1", "state": "AVAILABLE"}
                         ]
                     },
+                )
+            elif (self.path == "/v1/metrics"
+                  and hasattr(scorer, "metrics_snapshot")):
+                self._send(
+                    200, {"model": model_name, **scorer.metrics_snapshot()}
                 )
             else:
                 self._send(404, {"error": f"unknown path {self.path!r}"})
@@ -503,7 +440,8 @@ def make_handler(scorer: Scorer, model_name: str):
 def serve_pool(
     servable_dir: str, *, workers: int, port: int = 8501,
     host: str = "127.0.0.1", model_name: str = "deepfm",
-    batch_size: int = 256, item_corpus: str | None = None,
+    buckets=(8, 32, 128, 512), max_wait_ms: float = 2.0,
+    max_queue_rows: int | None = None, item_corpus: str | None = None,
     max_restarts: int = 10,
     ready: threading.Event | None = None,
 ) -> None:
@@ -544,7 +482,8 @@ def serve_pool(
                 ScoringHTTPServer.reuse_port = True
                 serve_forever(
                     servable_dir, port=port, host=host,
-                    model_name=model_name, batch_size=batch_size,
+                    model_name=model_name, buckets=buckets,
+                    max_wait_ms=max_wait_ms, max_queue_rows=max_queue_rows,
                     item_corpus=item_corpus,
                 )
             except BaseException:
@@ -626,21 +565,29 @@ def serve_pool(
 
 def serve_forever(
     servable_dir: str, *, port: int = 8501, host: str = "127.0.0.1",
-    model_name: str = "deepfm", batch_size: int = 256,
+    model_name: str = "deepfm", buckets=(8, 32, 128, 512),
+    max_wait_ms: float = 2.0, max_queue_rows: int | None = None,
     item_corpus: str | None = None,
     ready: threading.Event | None = None,
 ) -> None:
     """Serve whichever servable lives at ``servable_dir``: CTR models get
     ``:predict``; two-tower retrieval gets ``:encode_user``/``:encode_item``
-    and — with ``item_corpus`` — ``:retrieve``."""
+    and — with ``item_corpus`` — ``:retrieve``.  Both ride the bucketed
+    micro-batching engine (serve/batcher.py), precompiled before the
+    socket opens so the first request never pays a compile."""
     import os
 
     from .export import _load_config, load_retrieval_servable, load_servable
 
+    buckets = _parse_buckets(buckets)
     cfg = _load_config(os.path.abspath(servable_dir))
     if cfg.model.model_name == "two_tower":
         encode_user, encode_item, cfg = load_retrieval_servable(servable_dir)
-        rscorer = RetrievalScorer(encode_user, encode_item, cfg, batch_size)
+        rscorer = RetrievalScorer(
+            encode_user, encode_item, cfg, buckets=buckets,
+            max_wait_ms=max_wait_ms, max_queue_rows=max_queue_rows,
+        )
+        compiles = rscorer.precompile()
         if item_corpus:
             n = rscorer.load_corpus(item_corpus)
             print(f"encoded item corpus: {n} items", file=sys.stderr)
@@ -653,11 +600,14 @@ def serve_forever(
                 f"{servable_dir!r} holds {cfg.model.model_name!r}"
             )
         predict, cfg = load_servable(servable_dir)
-        # micro-batching front: concurrent requests share dispatches
-        # (backpressure coalescing, no idle latency — see BatchingScorer)
-        scorer = BatchingScorer(Scorer(predict, cfg.model.field_size, batch_size))
+        scorer = MicroBatcher(
+            predict, cfg.model.field_size, buckets=buckets,
+            max_wait_ms=max_wait_ms, max_queue_rows=max_queue_rows,
+        )
+        compiles = scorer.precompile()
         handler = make_handler(scorer, model_name)
         endpoint = "predict"
+    print(f"precompiled bucket executables: {compiles}", file=sys.stderr)
     httpd = ScoringHTTPServer((host, port), handler)
     if ready is not None:
         ready.port = httpd.server_address[1]  # type: ignore[attr-defined]
@@ -670,13 +620,28 @@ def serve_forever(
     httpd.serve_forever()
 
 
-def score_stdin(servable_dir: str, *, batch_size: int = 256) -> int:
-    """libsvm or JSONL lines on stdin -> one probability per line."""
+def score_stdin(
+    servable_dir: str, *, batch_size: int = 256,
+    buckets=(8, 32, 128, 512),
+) -> int:
+    """libsvm or JSONL lines on stdin -> one probability per line.
+
+    Lines buffer up to ``batch_size`` per flush; each flush scores through
+    the bucketed engine with ``max_wait_ms=0`` (a pipeline has exactly one
+    caller — coalescing across callers can't happen, so any admission wait
+    would be pure added latency)."""
     from ..data.libsvm import parse_libsvm_line
     from .export import load_servable
 
     predict, cfg = load_servable(servable_dir)
-    scorer = Scorer(predict, cfg.model.field_size, batch_size)
+    # a full flush is exactly batch_size rows: make that an exact bucket
+    # shape, or every full flush would pad up to the next power of two
+    # (256 -> 512 doubles the compute of the steady-state case)
+    bucket_set = set(_parse_buckets(buckets)) | {int(batch_size)}
+    scorer = MicroBatcher(
+        predict, cfg.model.field_size, buckets=sorted(bucket_set),
+        max_wait_ms=0.0,
+    )
     count = 0
     buf_ids: list[list[int]] = []
     buf_vals: list[list[float]] = []
@@ -695,21 +660,24 @@ def score_stdin(servable_dir: str, *, batch_size: int = 256) -> int:
         buf_ids.clear()
         buf_vals.clear()
 
-    for line in sys.stdin:
-        line = line.strip()
-        if not line:
-            continue
-        if line.startswith("{"):
-            obj = json.loads(line)
-            buf_ids.append(obj["feat_ids"])
-            buf_vals.append(obj["feat_vals"])
-        else:
-            _, ids, vals = parse_libsvm_line(line)
-            buf_ids.append(ids)
-            buf_vals.append(vals)
-        if len(buf_ids) >= batch_size:
-            flush()
-    flush()
+    try:
+        for line in sys.stdin:
+            line = line.strip()
+            if not line:
+                continue
+            if line.startswith("{"):
+                obj = json.loads(line)
+                buf_ids.append(obj["feat_ids"])
+                buf_vals.append(obj["feat_vals"])
+            else:
+                _, ids, vals = parse_libsvm_line(line)
+                buf_ids.append(ids)
+                buf_vals.append(vals)
+            if len(buf_ids) >= batch_size:
+                flush()
+        flush()
+    finally:
+        scorer.close()  # in-process callers must not leak worker threads
     sys.stdout.flush()
     return count
 
@@ -730,7 +698,27 @@ def main(argv: list[str] | None = None) -> int:
              "encoded at startup to enable the :retrieve endpoint",
     )
     ap.add_argument("--model-name", default="deepfm")
-    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument(
+        "--buckets", default="8,32,128,512",
+        help="micro-batch bucket sizes (comma-separated, ascending): "
+             "coalesced requests pad to the smallest bucket that fits; "
+             "each bucket is one precompiled XLA executable",
+    )
+    ap.add_argument(
+        "--max-wait-ms", type=float, default=2.0,
+        help="admission timeout: max time a request waits for bucket-mates "
+             "on an idle engine (under load the previous dispatch is the "
+             "coalescing window and no extra wait happens)",
+    )
+    ap.add_argument(
+        "--max-queue-rows", type=int, default=None,
+        help="queue-depth bound in rows (default 16x the largest bucket); "
+             "beyond it requests are shed with HTTP 503",
+    )
+    ap.add_argument(
+        "--batch-size", type=int, default=256,
+        help="stdin mode only: lines buffered per scoring flush",
+    )
     ap.add_argument(
         "--workers", type=int, default=1,
         help="N>1: SO_REUSEPORT process pool — N independent server "
@@ -744,18 +732,22 @@ def main(argv: list[str] | None = None) -> int:
     )
     args = ap.parse_args(argv)
     if args.stdin:
-        score_stdin(args.servable, batch_size=args.batch_size)
+        score_stdin(args.servable, batch_size=args.batch_size,
+                    buckets=args.buckets)
         return 0
     if args.workers > 1:
         serve_pool(
             args.servable, workers=args.workers, port=args.port,
             host=args.host, model_name=args.model_name,
-            batch_size=args.batch_size, item_corpus=args.item_corpus,
+            buckets=args.buckets, max_wait_ms=args.max_wait_ms,
+            max_queue_rows=args.max_queue_rows,
+            item_corpus=args.item_corpus,
         )
         return 0
     serve_forever(
         args.servable, port=args.port, host=args.host,
-        model_name=args.model_name, batch_size=args.batch_size,
+        model_name=args.model_name, buckets=args.buckets,
+        max_wait_ms=args.max_wait_ms, max_queue_rows=args.max_queue_rows,
         item_corpus=args.item_corpus,
     )
     return 0

@@ -643,6 +643,8 @@ def run_task(cfg: Config):
                 workers=cfg.run.serve_workers,
                 port=cfg.run.serve_port,
                 host=cfg.run.serve_host,
+                buckets=cfg.run.serve_buckets,
+                max_wait_ms=cfg.run.serve_max_wait_ms,
                 item_corpus=cfg.run.serve_item_corpus or None,
             )
             return None
@@ -650,6 +652,8 @@ def run_task(cfg: Config):
             cfg.run.servable_model_dir,
             port=cfg.run.serve_port,
             host=cfg.run.serve_host,
+            buckets=cfg.run.serve_buckets,
+            max_wait_ms=cfg.run.serve_max_wait_ms,
             item_corpus=cfg.run.serve_item_corpus or None,
         )
         return None

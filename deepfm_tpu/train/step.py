@@ -196,6 +196,9 @@ def _make_lazy_train_step(cfg: Config, model, tx) -> Callable:
         keys = _lazy_keys(params)
         rest = {k: v for k, v in params.items() if k not in keys}
         tables = {k: params[k] for k in keys}
+        # raw batch ids are UNVALIDATED here; narrow_ids clips to
+        # [0, feature_size) before its int32 cast so an out-of-range int64
+        # id cannot wrap onto an arbitrary row (see its docstring)
         ids = narrow_ids(batch["feat_ids"], cfg.model.feature_size,
                          cfg.model.narrow_ids)
         ids = ids.reshape(-1, cfg.model.field_size)

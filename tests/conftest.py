@@ -18,12 +18,24 @@ if not os.environ.get("DEEPFM_TEST_TPU"):
         flags = (flags + " --xla_force_host_platform_device_count=8").strip()
     # 8 virtual devices time-slice few (often 1) CI cores: raise XLA:CPU's
     # 20s-warn/40s-KILL collective rendezvous watchdogs, which heavyweight
-    # compiles or steps can trip on an oversubscribed host
+    # compiles or steps can trip on an oversubscribed host.  The flags are
+    # probed first: a jaxlib whose XLA predates them HARD-ABORTS the whole
+    # pytest process on unknown XLA_FLAGS at first backend init (observed
+    # on jaxlib 0.4.36 — every test "failed" with zero tests run), and an
+    # old XLA without the flags has no raisable watchdog anyway.
     if "xla_cpu_collective_call_terminate_timeout_seconds" not in flags:
-        flags += (
-            " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+        watchdog = (
+            "--xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
             " --xla_cpu_collective_call_terminate_timeout_seconds=900"
         )
+        import sys as _sys
+
+        _sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from deepfm_tpu.core.platform import xla_flags_supported
+
+        if xla_flags_supported(watchdog):
+            flags = f"{flags} {watchdog}"
     os.environ["XLA_FLAGS"] = flags
     # The environment's sitecustomize registers an experimental TPU-tunnel
     # PJRT plugin ("axon") at interpreter start and hooks jax's backend
@@ -35,6 +47,14 @@ if not os.environ.get("DEEPFM_TEST_TPU"):
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+        # value-stable RNG regardless of output sharding: jax < 0.5
+        # defaults this off, and then jit(init, out_shardings=sharded)
+        # produces DIFFERENT table values than the dense init — breaking
+        # every sharded-vs-dense parity assertion (newer jax defaults on)
+        try:
+            jax.config.update("jax_threefry_partitionable", True)
+        except Exception:
+            pass
         from jax._src import xla_bridge as _xb
 
         _xb._backend_factories.pop("axon", None)

@@ -130,6 +130,8 @@ def test_serve_task_dispatch(monkeypatch):
         "dir": "/x/servable",
         "port": 1234,
         "host": "0.0.0.0",
+        "buckets": "8,32,128,512",
+        "max_wait_ms": 2.0,
         "item_corpus": None,
     }
 

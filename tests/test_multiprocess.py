@@ -47,6 +47,13 @@ def _run_workers(tmp_path, *, lazy: bool, nproc: int = 2,
             for q in procs:
                 q.kill()
             pytest.fail("multi-process worker timed out")
+        if "Multiprocess computations aren't implemented" in err:
+            # capability gate, not a code failure: this jaxlib's CPU
+            # backend has no cross-process collectives (added in newer
+            # XLA builds) — nothing the framework can do about it here
+            for q in procs:
+                q.kill()
+            pytest.skip("CPU backend lacks multi-process collectives")
         assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
         outs.append((out, err))
     results = []
@@ -164,6 +171,10 @@ def test_two_process_cli_lifecycle(tmp_path):
             for q in procs:
                 q.kill()
             pytest.fail("CLI multi-process worker timed out")
+        if "Multiprocess computations aren't implemented" in err:
+            for q in procs:
+                q.kill()
+            pytest.skip("CPU backend lacks multi-process collectives")
         assert p.returncode == 0, f"cli worker failed:\n{err[-3000:]}"
         outs.append(out)
     for out in outs:

@@ -60,62 +60,13 @@ def test_scorer_matches_direct_predict(servable_dir):
     np.testing.assert_allclose(got, want, rtol=1e-6)
 
 
-def test_batching_scorer_concurrent_correctness(servable_dir):
-    """32 concurrent single-row requests through the micro-batching front:
-    every caller gets ITS row's probability (slices fan back to the right
-    request), equal to the direct scorer result; malformed shapes fail on
-    the caller's thread without poisoning anyone's batch."""
-    from deepfm_tpu.serve.server import BatchingScorer
-
-    predict, cfg = load_servable(servable_dir)
-    scorer = Scorer(predict, cfg.model.field_size, batch_size=8)
-    front = BatchingScorer(scorer)
-    inst = _instances(32, seed=2)
-    ids = np.asarray([i["feat_ids"] for i in inst], np.int64)
-    vals = np.asarray([i["feat_vals"] for i in inst], np.float32)
-    want = np.asarray(predict(ids, vals))
-
-    results: dict[int, np.ndarray] = {}
-    errors: list[Exception] = []
-    lock = threading.Lock()
-
-    def one(i):
-        try:
-            r = front.score(ids[i : i + 1], vals[i : i + 1])
-            with lock:
-                results[i] = r
-        except Exception as e:  # pragma: no cover - failure reporting
-            with lock:
-                errors.append(e)
-
-    threads = [threading.Thread(target=one, args=(i,)) for i in range(32)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    assert not errors
-    got = np.concatenate([results[i] for i in range(32)])
-    np.testing.assert_allclose(got, want, rtol=1e-6)
-
-    # malformed request fails alone, front still serves afterwards
-    with pytest.raises(ValueError, match="expected"):
-        front.score(np.zeros((2, 3), np.int64), np.zeros((2, 3), np.float32))
-    np.testing.assert_allclose(
-        front.score(ids[:1], vals[:1]), want[:1], rtol=1e-6
-    )
-    # empty request short-circuits
-    assert front.score(
-        np.zeros((0, cfg.model.field_size), np.int64),
-        np.zeros((0, cfg.model.field_size), np.float32),
-    ).shape == (0,)
-
-
 def test_rest_endpoint_tf_serving_shape(servable_dir):
     ready = threading.Event()
     t = threading.Thread(
         target=serve_forever,
         args=(servable_dir,),
-        kwargs=dict(port=0, model_name="deepfm", batch_size=8, ready=ready),
+        kwargs=dict(port=0, model_name="deepfm", buckets=(4, 8),
+                    max_wait_ms=1.0, ready=ready),
         daemon=True,
     )
     t.start()
@@ -185,6 +136,22 @@ def test_rest_endpoint_tf_serving_shape(servable_dir):
     with urllib.request.urlopen(base, timeout=30) as r:
         assert r.status == 200
 
+    # GET /v1/metrics: the micro-batching engine's counters — request
+    # count, batch-size histogram over the configured buckets, queue
+    # depth, latency percentiles
+    metrics_url = f"http://127.0.0.1:{port}/v1/metrics"
+    with urllib.request.urlopen(metrics_url, timeout=30) as r:
+        m = json.load(r)
+    assert m["model"] == "deepfm"
+    assert m["engine"] == "micro_batcher"
+    assert m["buckets"] == [4, 8]
+    assert m["requests_total"] >= 2  # json + binary predicts above
+    assert m["queue_rows"] == 0
+    assert set(m["batch_size_hist"]) == {"4", "8"}
+    assert sum(m["batch_size_hist"].values()) == m["dispatches_total"] > 0
+    for p in ("p50", "p95", "p99"):
+        assert m["latency_ms"][p] >= 0.0
+
 
 @pytest.fixture(scope="module")
 def retrieval_servable_dir(tmp_path_factory):
@@ -246,7 +213,7 @@ def test_retrieval_endpoints(retrieval_servable_dir, tmp_path):
         target=serve_forever,
         args=(retrieval_servable_dir,),
         kwargs=dict(
-            port=0, model_name="tower", batch_size=8,
+            port=0, model_name="tower", buckets=(4, 8), max_wait_ms=1.0,
             item_corpus=str(corpus_path), ready=ready,
         ),
         daemon=True,
@@ -301,6 +268,17 @@ def test_retrieval_endpoints(retrieval_servable_dir, tmp_path):
     want = np.argsort(-all_scores, axis=1)[:, :5] + 1000
     np.testing.assert_array_equal(neighbors, want)
 
+    # per-tower metrics: each side has its own micro-batching engine
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{ready.port}/v1/metrics", timeout=30
+    ) as r:
+        m = json.load(r)
+    assert m["model"] == "tower"
+    assert m["user"]["engine"] == m["item"]["engine"] == "micro_batcher"
+    # corpus encode (25 items) + the user encodes above went through
+    assert m["item"]["rows_total"] >= 25
+    assert m["user"]["rows_total"] >= 3
+
 
 def test_stdin_scoring_libsvm_and_jsonl(servable_dir, monkeypatch, capsys):
     rng = np.random.default_rng(3)
@@ -327,52 +305,6 @@ def test_stdin_scoring_libsvm_and_jsonl(servable_dir, monkeypatch, capsys):
     np.testing.assert_allclose(out, np.asarray(predict(ids, vals)), atol=1e-5)
 
 
-def test_batching_scorer_sheds_load_with_503_semantics(servable_dir):
-    """Bounded queue (VERDICT r04 / ADVICE r04): when the backlog exceeds
-    max_queue_rows, new callers fail fast with OverloadedError instead of
-    queueing unboundedly; the backlog itself still completes."""
-    import time
-
-    from deepfm_tpu.serve.server import BatchingScorer, OverloadedError
-
-    predict, cfg = load_servable(servable_dir)
-
-    gate = threading.Event()
-
-    def slow_predict(ids, vals):
-        gate.wait(10)
-        return predict(ids, vals)
-
-    front = BatchingScorer(
-        Scorer(slow_predict, cfg.model.field_size, batch_size=8),
-        max_rows_per_dispatch=8, max_queue_rows=4,
-    )
-    inst = _instances(1, seed=5)
-    ids = np.asarray([inst[0]["feat_ids"]], np.int64)
-    vals = np.asarray([inst[0]["feat_vals"]], np.float32)
-
-    results, errors = [], []
-
-    def call():
-        try:
-            results.append(front.score(ids, vals))
-        except OverloadedError as e:
-            errors.append(e)
-
-    # first caller occupies the (gated) dispatch; the next 4 fill the
-    # queue to its bound; the rest must be shed
-    threads = [threading.Thread(target=call) for _ in range(8)]
-    for t in threads:
-        t.start()
-        time.sleep(0.05)  # deterministic arrival order
-    gate.set()
-    for t in threads:
-        t.join(timeout=20)
-    assert len(errors) >= 1, "no caller was shed at 2x the queue bound"
-    assert len(results) + len(errors) == 8
-    assert all(r.shape == (1,) for r in results)
-
-
 def test_serve_pool_so_reuseport(servable_dir):
     """SO_REUSEPORT process pool (VERDICT r04 #4): N worker processes share
     one port; concurrent clients get correct predictions; SIGTERM shuts the
@@ -388,7 +320,7 @@ def test_serve_pool_so_reuseport(servable_dir):
     proc = subprocess.Popen(
         [_sys.executable, "-m", "deepfm_tpu.serve.server",
          "--servable", servable_dir, "--port", "0", "--workers", "2",
-         "--batch-size", "8"],
+         "--buckets", "4,8"],
         stderr=subprocess.PIPE, text=True, env=env,
     )
     try:
@@ -461,20 +393,19 @@ def test_serve_pool_so_reuseport(servable_dir):
             proc.wait(timeout=10)
 
 
-def test_oversized_request_admitted_when_idle(servable_dir):
-    """A single request larger than the queue bound must be admitted on an
-    idle server (the bound sheds backlog, not request size) and chunk
-    through the fixed batch."""
-    from deepfm_tpu.serve.server import BatchingScorer
+def test_load_batching_servable(servable_dir):
+    """export.py's embeddable form: the servable closure behind the
+    precompiled micro-batching engine, correct against direct predict."""
+    from deepfm_tpu.serve import load_batching_servable
 
-    predict, cfg = load_servable(servable_dir)
-    front = BatchingScorer(
-        Scorer(predict, cfg.model.field_size, batch_size=8),
-        max_rows_per_dispatch=8, max_queue_rows=4,
+    front, cfg = load_batching_servable(
+        servable_dir, buckets=(4, 8), max_wait_ms=1.0
     )
-    inst = _instances(40, seed=9)  # 10x the queue bound
+    inst = _instances(11, seed=9)
     got = front.score_instances(inst)
+    predict, _ = load_servable(servable_dir)
     ids = np.asarray([i["feat_ids"] for i in inst], np.int64)
     vals = np.asarray([i["feat_vals"] for i in inst], np.float32)
     np.testing.assert_allclose(got, np.asarray(predict(ids, vals)),
                                rtol=1e-5)
+    front.close()
