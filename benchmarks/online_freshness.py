@@ -1,0 +1,308 @@
+"""Online-training freshness benchmark: event→served lag and predict-tail
+latency with hot weight swaps enabled vs disabled.
+
+The online subsystem's value claim is twofold and this bench measures both
+halves:
+
+  * **freshness**: how long after an event lands in the log do live predict
+    responses reflect weights trained on it?  Measured per published
+    version as ``t(first predict served on version v) - watermark(v)``
+    where the watermark is the publish time of the newest event segment the
+    version consumed (the manifest records it; ground truth, not inference).
+  * **tail-latency cost of swapping**: closed-loop concurrent clients
+    hammer the micro-batching engine for the whole run; p50/p99 with the
+    trainer+HotSwapper live are compared against an identical run with
+    static weights.  The design claim — swaps are jit cache hits plus one
+    drained pointer swap — predicts a near-zero p99 delta.
+
+Topology (all in-process, CPU-friendly): a feeder thread appends event
+segments → OnlineTrainer (follow mode) trains and publishes versions →
+HotSwapper polls and swaps under a precompiled MicroBatcher while client
+threads score.
+
+Persists docs/BENCH_ONLINE.json ({latest, runs}).
+
+Run:  JAX_PLATFORMS=cpu python benchmarks/online_freshness.py --persist
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import _bench_util as bu
+
+V, F = 2000, 13
+
+
+def _cfg(root: str, batch_size: int, publish_every: int):
+    from deepfm_tpu.core.config import Config
+
+    return Config.from_dict({
+        "model": {
+            "feature_size": V,
+            "field_size": F,
+            "embedding_size": 8,
+            "deep_layers": (32, 16),
+            "dropout_keep": (1.0, 1.0),
+            "compute_dtype": "float32",
+        },
+        "optimizer": {"learning_rate": 0.01},
+        "data": {
+            "training_data_dir": os.path.join(root, "stream"),
+            "batch_size": batch_size,
+        },
+        "run": {
+            "model_dir": os.path.join(root, "ckpt"),
+            "servable_model_dir": os.path.join(root, "publish"),
+            "checkpoint_every_steps": publish_every,
+            "online_publish_every_steps": publish_every,
+            "log_steps": 10_000_000,
+        },
+    })
+
+
+def _client_loop(engine, stop, lats, errors, seed):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, V, (2, F)).astype(np.int64)
+    vals = rng.random((2, F)).astype(np.float32)
+    while not stop.is_set():
+        t0 = time.perf_counter()
+        try:
+            engine.score(ids, vals)
+        except Exception as e:
+            errors.append(f"{type(e).__name__}: {e}")
+            return
+        lats.append(time.perf_counter() - t0)
+
+
+def _pcts(lats):
+    if not lats:
+        return {}
+    a = np.sort(np.asarray(lats))
+    return {
+        "count": int(a.size),
+        "p50_ms": round(1e3 * float(a[int(0.50 * (a.size - 1))]), 3),
+        "p95_ms": round(1e3 * float(a[int(0.95 * (a.size - 1))]), 3),
+        "p99_ms": round(1e3 * float(a[int(0.99 * (a.size - 1))]), 3),
+        "max_ms": round(1e3 * float(a[-1]), 3),
+    }
+
+
+def run_static_phase(servable_dir, *, clients, duration_s, buckets):
+    """Baseline: same engine, same traffic, weights never move."""
+    from deepfm_tpu.serve.batcher import MicroBatcher
+    from deepfm_tpu.serve.export import load_servable
+
+    predict, cfg = load_servable(servable_dir)
+    engine = MicroBatcher(predict, F, buckets=buckets, max_wait_ms=1.0)
+    engine.precompile()
+    stop, lats, errors = threading.Event(), [], []
+    threads = [
+        threading.Thread(target=_client_loop,
+                         args=(engine, stop, lats, errors, 100 + i))
+        for i in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join()
+    engine.close()
+    return {"latency": _pcts(lats), "errors": errors[:3]}
+
+
+def run_swap_phase(root, servable_dir, *, clients, duration_s, buckets,
+                  batch_size, publish_every, segment_rows, feed_hz):
+    """Live loop: feeder -> trainer -> publisher -> HotSwapper, with
+    concurrent scoring clients measuring the whole time."""
+    from deepfm_tpu.online import OnlineTrainer, append_segment
+    from deepfm_tpu.serve.batcher import MicroBatcher
+    from deepfm_tpu.serve.reload import HotSwapper, load_swappable_servable
+
+    cfg = _cfg(root, batch_size, publish_every)
+    predict, predict_with, holder, scfg = load_swappable_servable(servable_dir)
+    engine = MicroBatcher(predict, F, buckets=buckets, max_wait_ms=1.0)
+    engine.precompile()
+    swapper = HotSwapper(
+        holder, predict_with, cfg.run.servable_model_dir, scfg,
+        interval_secs=0.1,
+    )
+
+    stop = threading.Event()
+    rng = np.random.default_rng(0)
+
+    def feeder():
+        seq = 0
+        period = 1.0 / feed_hz
+        while not stop.is_set():
+            labels = (rng.random(segment_rows) < 0.3).astype(np.float32)
+            ids = rng.integers(0, V, (segment_rows, F)).astype(np.int64)
+            vals = rng.random((segment_rows, F)).astype(np.float32)
+            append_segment(cfg.data.training_data_dir, labels, ids, vals,
+                           seq=seq)
+            seq += 1
+            stop.wait(period)
+
+    trainer = OnlineTrainer(cfg)
+
+    def train_loop():
+        try:
+            trainer.run(follow=True, stop=stop)
+        except Exception as e:
+            print(f"trainer died: {type(e).__name__}: {e}", file=sys.stderr)
+
+    # swap observer: first wall-clock moment each version is LIVE on the
+    # serving engine (holder.version flips only after canary + drain).  The
+    # watermark is read off the live manifest at that instant — retention
+    # may delete old manifests before a post-hoc read
+    serve_times: dict[int, tuple[float, float]] = {}
+
+    def observe():
+        last = holder.version
+        while not stop.is_set():
+            v = holder.version
+            if v != last:
+                m = holder.manifest
+                serve_times[v] = (
+                    time.time(), getattr(m, "watermark", 0.0) or 0.0
+                )
+                last = v
+            time.sleep(0.002)
+
+    lats, errors = [], []
+    threads = [threading.Thread(target=feeder),
+               threading.Thread(target=train_loop),
+               threading.Thread(target=observe)]
+    threads += [
+        threading.Thread(target=_client_loop,
+                         args=(engine, stop, lats, errors, 200 + i))
+        for i in range(clients)
+    ]
+    swapper.start()
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    swapper.stop()
+    engine.close()
+
+    # freshness: served time vs the manifest's event-time watermark
+    freshness = [
+        round(t_served - wm, 3)
+        for _v, (t_served, wm) in sorted(serve_times.items())
+        if wm > 0
+    ]
+    status = swapper.status()
+    return {
+        "latency": _pcts(lats),
+        "errors": errors[:3],
+        "versions_served": len(serve_times),
+        "swaps_total": status["swaps_total"],
+        "rollbacks_total": status["rollbacks_total"],
+        "last_swap_ms": status["last_swap_ms"],
+        "freshness_lag_s": {
+            "samples": freshness,
+            "mean": round(float(np.mean(freshness)), 3) if freshness else None,
+            "max": round(float(np.max(freshness)), 3) if freshness else None,
+        },
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--duration", type=float, default=12.0,
+                    help="seconds per phase (static and swapping)")
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--publish-every", type=int, default=4,
+                    help="trainer steps per published version")
+    ap.add_argument("--segment-rows", type=int, default=64)
+    ap.add_argument("--feed-hz", type=float, default=2.0,
+                    help="event segments appended per second")
+    ap.add_argument("--buckets", default="4,16")
+    ap.add_argument("--persist", action="store_true")
+    args = ap.parse_args()
+
+    from deepfm_tpu.core.platform import sanitize_backend
+
+    sanitize_backend()
+    from deepfm_tpu.serve.export import export_servable
+    from deepfm_tpu.train import create_train_state
+
+    buckets = tuple(int(x) for x in args.buckets.split(","))
+    platform, device = bu.backend_platform()
+    root = tempfile.mkdtemp(prefix="online_freshness_")
+    cfg = _cfg(root, args.batch_size, args.publish_every)
+    servable = os.path.join(root, "servable_v0")
+    export_servable(cfg, create_train_state(cfg), servable)
+
+    print("phase 1/2: static weights baseline", file=sys.stderr)
+    static = run_static_phase(
+        servable, clients=args.clients, duration_s=args.duration,
+        buckets=buckets,
+    )
+    print("phase 2/2: live trainer + hot swaps", file=sys.stderr)
+    swap = run_swap_phase(
+        root, servable, clients=args.clients, duration_s=args.duration,
+        buckets=buckets, batch_size=args.batch_size,
+        publish_every=args.publish_every, segment_rows=args.segment_rows,
+        feed_hz=args.feed_hz,
+    )
+
+    out = {
+        "bench": "online_freshness",
+        "platform": platform,
+        "device": device,
+        "config": {
+            "duration_s": args.duration,
+            "clients": args.clients,
+            "batch_size": args.batch_size,
+            "publish_every_steps": args.publish_every,
+            "segment_rows": args.segment_rows,
+            "feed_hz": args.feed_hz,
+            "buckets": list(buckets),
+            "model": {"feature_size": V, "field_size": F},
+        },
+        "static": static,
+        "swapping": swap,
+        "p99_delta_ms": (
+            round(swap["latency"].get("p99_ms", 0.0)
+                  - static["latency"].get("p99_ms", 0.0), 3)
+            if swap["latency"] and static["latency"] else None
+        ),
+        "note": (
+            "single-host bench: the trainer (jit compiles, train steps, "
+            "checkpoint writes) shares cores with the serving threads, so "
+            "the swapping phase's tail latency includes that CPU "
+            "contention — compare p50 (engine health) and last_swap_ms "
+            "(the swap mechanism itself) for the swap cost in isolation; "
+            "production runs the trainer on a separate host"
+        ),
+    }
+    print(json.dumps(out, indent=2))
+    ok = int(bool(swap["latency"]) and not swap["errors"]
+             and swap["swaps_total"] > 0)
+    if args.persist:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "..", "docs", "BENCH_ONLINE.json")
+        bu.persist_latest_runs(os.path.normpath(path), out, ok=ok,
+                               platform=platform)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

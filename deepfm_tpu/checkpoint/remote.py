@@ -12,7 +12,13 @@ staging-directory mirror against any URL the S3-wire-subset client
   ``<url>/<step>/...`` and publishes a ``_COMMIT_<step>`` marker object
   LAST — readers treat only marker-bearing steps as complete, so a crash
   mid-upload never yields a half checkpoint (the atomic-publish semantics
-  Orbax gets from a rename on a filesystem).
+  Orbax gets from a rename on a filesystem).  Transient upload errors
+  retry with bounded exponential backoff; a step that exhausts its
+  retries is logged on the next ``save()`` and re-enqueued there (while
+  it still exists locally and lacks a marker) — the explicit barriers
+  (``wait_until_finished``, ``close``, ``save(block=True)``) raise — so
+  an object-store outage costs latency, not checkpoints or the training
+  process.
 * **restore / latest_step**: list remote committed steps; any step missing
   locally is downloaded into staging first, then restored through the
   normal sharding-aware path.
@@ -62,6 +68,8 @@ class RemoteCheckpointer:
         max_to_keep: int = 3,
         async_save: bool = True,
         staging_dir: str | None = None,
+        upload_retries: int = 3,
+        retry_backoff_secs: float = 0.2,
     ):
         if jax.process_count() > 1:
             # Orbax's collective save needs ONE shared directory all
@@ -102,6 +110,11 @@ class RemoteCheckpointer:
         self._is_writer = jax.process_index() == 0
         self._uploader: threading.Thread | None = None
         self._upload_err: BaseException | None = None
+        self._upload_retries = max(1, int(upload_retries))
+        self._retry_backoff = float(retry_backoff_secs)
+        # steps whose upload exhausted its retries: re-enqueued on the next
+        # save() so a transient outage costs latency, not a lost checkpoint
+        self._failed_steps: set[int] = set()
 
     # -- remote index ------------------------------------------------------
     def _remote_steps(self) -> list[int]:
@@ -139,23 +152,75 @@ class RemoteCheckpointer:
 
     # -- Checkpointer interface --------------------------------------------
     def save(self, state: TrainState, *, block: bool = False) -> bool:
-        self._join_uploader()  # serialize uploads; surface prior failures
+        # serialize uploads; a PRIOR upload failure is logged, not raised —
+        # raising here would skip this state's local save and kill the
+        # (uncatching) train loops, turning an object-store outage into
+        # lost checkpoints.  The failed step stays in _failed_steps and is
+        # re-enqueued below; explicit barriers (wait_until_finished, close,
+        # block=True) still raise for callers that demand durability.
+        try:
+            self._join_uploader()
+        except Exception as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "remote checkpoint upload failed (step re-enqueued, will "
+                "retry on this save): %s", e
+            )
         saved = self._local.save(state, block=block)
-        if saved and self._is_writer:
-            step = int(state.step)
+        if self._is_writer and (saved or self._pending_steps()):
+            steps = self._pending_steps()
+            if saved:
+                steps = [s for s in steps if s != int(state.step)]
+                steps.append(int(state.step))
             self._uploader = threading.Thread(
-                target=self._try_upload, args=(step,), daemon=True
+                target=self._try_upload_many, args=(steps,), daemon=True
             )
             self._uploader.start()
             if block:
                 self._join_uploader()
         return saved
 
-    def _try_upload(self, step: int) -> None:
-        try:
-            self._upload_step(step)
-        except BaseException as e:
-            self._upload_err = e
+    def _pending_steps(self) -> list[int]:
+        """Previously-failed uploads still worth retrying: the step must
+        still exist locally (retention may have dropped it) and still lack
+        a remote commit marker (a step that failed only in its post-marker
+        retention phase is already committed — re-uploading it would be
+        pure waste)."""
+        if not self._failed_steps:
+            return []
+        self._failed_steps &= set(self._local.all_steps())
+        if self._failed_steps:
+            try:
+                self._failed_steps -= set(self._remote_steps())
+            except Exception:
+                pass  # listing down: retry the uploads anyway (idempotent)
+        return sorted(self._failed_steps)
+
+    def _try_upload_many(self, steps: list[int]) -> None:
+        for step in steps:
+            try:
+                self._upload_with_retries(step)
+                self._failed_steps.discard(step)
+            except BaseException as e:
+                self._upload_err = e
+                self._failed_steps.add(step)
+
+    def _upload_with_retries(self, step: int) -> None:
+        """Bounded retry-with-backoff for transient object-store errors —
+        one flaky PUT must not orphan a whole checkpoint step."""
+        import time
+
+        delay = self._retry_backoff
+        for attempt in range(self._upload_retries):
+            try:
+                self._upload_step(step)
+                return
+            except Exception:
+                if attempt == self._upload_retries - 1:
+                    raise
+                time.sleep(delay)
+                delay *= 2
 
     def wait_until_finished(self) -> None:
         self._local.wait_until_finished()

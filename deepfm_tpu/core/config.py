@@ -223,8 +223,11 @@ class RunConfig:
     (SM_HOSTS/SM_CURRENT_HOST analogs, ps:80-95)."""
 
     task_type: str = "train"          # train | eval | infer | export | serve
-                                      # (ps:77-79; serve = online scoring over
-                                      # the exported servable, serve/server.py)
+                                      # | online-train (ps:77-79; serve =
+                                      # online scoring over the exported
+                                      # servable, serve/server.py; online-
+                                      # train = continuous training from an
+                                      # event log, online/trainer.py)
     model_dir: str = "./model_dir"
     servable_model_dir: str = "./servable"
     clear_existing_model: bool = False  # hvd:66-68
@@ -264,6 +267,19 @@ class RunConfig:
     # IDLE engine (under load the running dispatch is the coalescing
     # window and no extra wait happens)
     serve_max_wait_ms: float = 2.0
+    # hot weight reload (serve/reload.py): publish root (dir or object URL,
+    # online/publisher.py) polled for new versions; "" = static weights.
+    # New versions swap under the precompiled bucket executables after a
+    # canary probe, with in-flight dispatches drained across the swap.
+    serve_reload_url: str = ""
+    serve_reload_interval_secs: float = 2.0
+    # online continuous training (task_type=online-train, online/trainer.py):
+    # publish a servable version every N optimizer steps (0 = only at
+    # stream end); stop after N batches (0 = unbounded); stop after N
+    # seconds without new events (0 = tail forever)
+    online_publish_every_steps: int = 100
+    online_max_batches: int = 0
+    online_idle_timeout_secs: float = 0.0
     # in-process crash retries with resume-from-checkpoint (the spot-retry
     # analog of use_spot_instances/max_wait, both notebooks cell 4)
     max_restarts: int = 0

@@ -58,9 +58,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--config", help="JSON config file (Config.to_dict schema)")
     p.add_argument(
         "--task_type",
-        choices=["train", "eval", "infer", "export", "serve"],
+        choices=["train", "eval", "infer", "export", "serve",
+                 "online-train", "online_train"],
         help="task dispatch (reference ps:77-79; serve = online scoring "
-             "over the exported servable)",
+             "over the exported servable; online-train = continuous "
+             "training from an event log with versioned publishes the "
+             "serving engine hot-reloads)",
     )
     # the high-traffic flags get first-class spellings (parity with the
     # reference's most-used hyperparameters, ps nb cell 4)

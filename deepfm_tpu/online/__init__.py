@@ -1,0 +1,18 @@
+from .publisher import (  # noqa: F401
+    Manifest,
+    ModelPublisher,
+    fetch_version,
+    latest_manifest,
+    list_versions,
+    read_manifest,
+)
+from .stream import (  # noqa: F401
+    DirectoryTail,
+    EventLogReader,
+    PrefixTail,
+    StreamCursor,
+    append_segment,
+    open_tail,
+    segment_name,
+)
+from .trainer import OnlinePayload, OnlineTrainer  # noqa: F401

@@ -1,0 +1,253 @@
+"""Versioned model manifests with marker-last atomic publish.
+
+The commit-marker protocol from ``checkpoint/remote.py`` (upload the tree,
+publish the ``_COMMIT_`` marker LAST, so readers never see a torn step)
+reused as the train→serve transport: each published version is a complete
+servable artifact under ``versions/<v>/`` plus a ``MANIFEST-<v>.json``
+object written last.  A reader that lists manifests and takes the max
+version therefore always resolves to a fully-written artifact — on a local
+filesystem (manifest lands via tmp-file + rename) and on an object store
+(single PUT) alike.
+
+The manifest carries everything the hot-swap path needs to validate a
+version *before* exposing it to traffic:
+
+    {version, step, param_hash, field_size, feature_size, model_name,
+     created_unix, cursor, watermark}
+
+``param_hash`` is a SHA-256 over the parameter pytree (leaf path + shape +
+dtype + bytes, in sorted path order): the serve side recomputes it after
+staging and refuses a mismatch, so a torn or corrupted download can never
+be swapped live.  ``cursor``/``watermark`` record the stream position and
+event-time horizon the version contains — the freshness benchmark's
+ground truth.
+
+Retention mirrors the checkpoint story: old versions beyond ``keep`` are
+deleted manifest-first, so a partially-deleted version is simply invisible,
+never half-readable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..data.object_store import get_store, is_url, join_url
+
+_MANIFEST = "MANIFEST-"
+_VERSIONS = "versions"
+
+
+def _version_name(version: int) -> str:
+    return f"{int(version):08d}"
+
+
+def param_tree_hash(params: Any, model_state: Any = None) -> str:
+    """SHA-256 over (path, shape, dtype, bytes) of every leaf, sorted by
+    path — a content address for the exact weights a version serves."""
+    h = hashlib.sha256()
+    tree = {"params": params, "model_state": model_state}
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    entries = []
+    for path, leaf in leaves:
+        if leaf is None:
+            continue
+        arr = np.asarray(jax.device_get(leaf))
+        entries.append((jax.tree_util.keystr(path), arr))
+    for key, arr in sorted(entries, key=lambda kv: kv[0]):
+        h.update(key.encode())
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
+@dataclass(frozen=True)
+class Manifest:
+    version: int
+    step: int
+    param_hash: str
+    field_size: int
+    feature_size: int
+    model_name: str
+    created_unix: float
+    cursor: dict | None = None
+    watermark: float = 0.0
+    extra: dict = field(default_factory=dict)
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "Manifest":
+        d = json.loads(text)
+        known = {f.name for f in cls.__dataclass_fields__.values()}  # type: ignore[attr-defined]
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+# -- read side (used by serve/reload.py and tooling) ------------------------
+
+def list_versions(root: str) -> list[int]:
+    """Committed (manifest-bearing) versions under ``root``, ascending."""
+    versions = []
+    if is_url(root):
+        base = root.rstrip("/") + "/"
+        names = [u[len(base):] for u in get_store().list_prefix(base)]
+    elif os.path.isdir(root):
+        names = os.listdir(root)
+    else:
+        return []
+    for name in names:
+        if name.startswith(_MANIFEST) and name.endswith(".json"):
+            try:
+                versions.append(int(name[len(_MANIFEST):-len(".json")]))
+            except ValueError:
+                continue
+    return sorted(versions)
+
+
+def _manifest_path(root: str, version: int) -> str:
+    name = f"{_MANIFEST}{_version_name(version)}.json"
+    return join_url(root, name) if is_url(root) else os.path.join(root, name)
+
+
+def version_location(root: str, version: int) -> str:
+    if is_url(root):
+        return join_url(root, _VERSIONS, _version_name(version))
+    return os.path.join(root, _VERSIONS, _version_name(version))
+
+
+def read_manifest(root: str, version: int) -> Manifest:
+    path = _manifest_path(root, version)
+    if is_url(root):
+        return Manifest.from_json(get_store().get(path).decode())
+    with open(path) as f:
+        return Manifest.from_json(f.read())
+
+
+def latest_manifest(root: str) -> Manifest | None:
+    versions = list_versions(root)
+    if not versions:
+        return None
+    return read_manifest(root, versions[-1])
+
+
+def fetch_version(root: str, version: int, staging_dir: str) -> str:
+    """Make version ``version``'s servable artifact locally readable:
+    local roots are returned in place; remote versions download into
+    ``staging_dir/<version>`` (skipped when already present)."""
+    loc = version_location(root, version)
+    if not is_url(root):
+        return loc
+    dest = os.path.join(staging_dir, _version_name(version))
+    if not os.path.isdir(dest):
+        tmp = dest + ".tmp"
+        shutil.rmtree(tmp, ignore_errors=True)
+        get_store().download_tree(loc, tmp)
+        os.replace(tmp, dest)
+    return dest
+
+
+# -- write side -------------------------------------------------------------
+
+class ModelPublisher:
+    """Single-writer publisher of versioned servable artifacts."""
+
+    def __init__(self, root: str, *, keep: int = 3):
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        self.root = root.rstrip("/") if is_url(root) else root
+        self._keep = keep
+        if not is_url(self.root):
+            os.makedirs(self.root, exist_ok=True)
+
+    def next_version(self) -> int:
+        versions = list_versions(self.root)
+        return (versions[-1] + 1) if versions else 1
+
+    def publish(
+        self,
+        cfg,
+        state,
+        *,
+        cursor: dict | None = None,
+        watermark: float = 0.0,
+        extra: dict | None = None,
+    ) -> Manifest:
+        """Write the servable tree for ``state``, then the manifest LAST.
+
+        Crash at any point before the manifest write leaves an invisible
+        partial version; the next publish claims a fresh version number
+        (numbers are taken from committed manifests only, so an orphaned
+        tree is overwritten or ignored, never resurrected)."""
+        from ..serve.export import export_servable
+
+        version = self.next_version()
+        manifest = Manifest(
+            version=version,
+            step=int(state.step),
+            param_hash=param_tree_hash(state.params, state.model_state),
+            field_size=cfg.model.field_size,
+            feature_size=cfg.model.feature_size,
+            model_name=cfg.model.model_name,
+            created_unix=time.time(),
+            cursor=cursor,
+            watermark=float(watermark),
+            extra=extra or {},
+        )
+        if is_url(self.root):
+            import tempfile
+
+            # clear any orphan objects from a crash after a previous upload
+            # of this version number (numbers come from committed manifests
+            # only): a stale extra object mixed into the fresh tree would
+            # fail the reader's param-hash check forever
+            get_store().delete_prefix(
+                version_location(self.root, version) + "/"
+            )
+            with tempfile.TemporaryDirectory(prefix="deepfm_publish_") as tmp:
+                export_servable(cfg, state, tmp)
+                get_store().upload_tree(
+                    tmp, version_location(self.root, version)
+                )
+            get_store().put(
+                _manifest_path(self.root, version), manifest.to_json().encode()
+            )
+        else:
+            dest = version_location(self.root, version)
+            shutil.rmtree(dest, ignore_errors=True)  # orphan from a crash
+            export_servable(cfg, state, dest)
+            path = _manifest_path(self.root, version)
+            tmp_path = path + ".tmp"
+            with open(tmp_path, "w") as f:
+                f.write(manifest.to_json())
+            os.replace(tmp_path, path)  # the atomic publish point
+        self._retain()
+        return manifest
+
+    def _retain(self) -> None:
+        versions = list_versions(self.root)
+        for v in versions[: max(0, len(versions) - self._keep)]:
+            # manifest first: a version missing its manifest is invisible
+            # to readers, so the tree delete can proceed (or crash) safely
+            if is_url(self.root):
+                get_store().delete(_manifest_path(self.root, v))
+                get_store().delete_prefix(
+                    version_location(self.root, v) + "/"
+                )
+            else:
+                try:
+                    os.remove(_manifest_path(self.root, v))
+                except FileNotFoundError:
+                    pass
+                shutil.rmtree(
+                    version_location(self.root, v), ignore_errors=True
+                )

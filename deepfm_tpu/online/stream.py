@@ -1,0 +1,321 @@
+"""Append-only event-log sources for online continuous training.
+
+The reference's Pipe-mode pipeline streams training data past the model
+instead of staging it (README.md:15, ``PipeModeDataset``) — but a FIFO has
+no *position*: a restarted consumer can only start over or miss data.  This
+module gives the streaming feed durable coordinates, the log-segment model
+every production event bus converges on:
+
+* **Segments, not appends.**  An event log is a directory (or object-store
+  prefix) into which producers publish immutable TFRecord *segments* with
+  monotonically increasing names (``segment_name(seq)`` — zero-padded so
+  lexicographic order == publish order).  A segment appears atomically
+  (tmp-file + rename locally; single PUT remotely), so a tailing reader
+  never observes a half-written file.
+* **Monotone cursors.**  A :class:`StreamCursor` is ``(segment, record)``:
+  every segment sorting strictly before ``segment`` is fully consumed, and
+  ``record`` records of ``segment`` itself are consumed.  Cursors only move
+  forward, and replay from a persisted cursor re-reads *at least* every
+  record at or after it — the at-least-once contract.  Exactly-once comes
+  from the consumer committing the cursor atomically with its own state
+  (see ``online/trainer.py``).
+* **Watermarks.**  ``EventLogReader.watermark()`` is the publish time of the
+  newest fully-consumed segment: every event at or before it has been read.
+  The freshness benchmark (benchmarks/online_freshness.py) measures
+  event→served lag against exactly this quantity.
+
+Both tails share one reader; only listing/opening differ:
+``DirectoryTail`` stats the filesystem, ``PrefixTail`` lists an
+object-store prefix through ``data/object_store.py`` (ListObjectsV2), so a
+training stream can live on the same S3-wire endpoint as the reference's
+channels.
+
+Reader bookkeeping (record counts, first-seen times) is pruned as the
+cursor passes each segment, so a long-lived tail's memory tracks the live
+window.  The per-poll LIST still enumerates every retained segment name —
+bound that with log retention: segments strictly *behind* every consumer's
+cursor may be deleted or archived at any time (the reader skips names
+behind its cursor without opening them); never remove a segment at or
+ahead of a live cursor.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import BinaryIO, Iterator, NamedTuple, Sequence
+
+import numpy as np
+
+from ..data.example_proto import decode_ctr_batch, serialize_ctr_example
+from ..data.object_store import get_store, is_url, join_url
+from ..data.tfrecord import frame_record, read_records
+
+_SEGMENT_SUFFIXES = (".tfrecords", ".tfrecord")
+
+
+class StreamCursor(NamedTuple):
+    """Durable stream position: segments ``< segment`` are fully consumed,
+    plus ``record`` records of ``segment`` itself.  The empty cursor
+    (``StreamCursor()``) means "start of log"."""
+
+    segment: str = ""
+    record: int = 0
+
+    def advanced_past(self, name: str) -> bool:
+        """True when ``name`` is fully behind this cursor (never re-read)."""
+        return bool(self.segment) and name < self.segment
+
+
+def segment_name(seq: int, *, suffix: str = ".tfrecords") -> str:
+    """Zero-padded so lexicographic order == numeric publish order."""
+    return f"{seq:012d}{suffix}"
+
+
+class DirectoryTail:
+    """Tail a local directory of immutable TFRecord segments."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def list_segments(self) -> list[str]:
+        if not os.path.isdir(self.path):
+            return []
+        out = [
+            name
+            for name in os.listdir(self.path)
+            if name.endswith(_SEGMENT_SUFFIXES)
+            and not name.startswith((".", "_"))
+            and os.path.isfile(os.path.join(self.path, name))
+        ]
+        return sorted(out)
+
+    def open_segment(self, name: str) -> BinaryIO:
+        return open(os.path.join(self.path, name), "rb")
+
+    def segment_time(self, name: str) -> float:
+        """Publish time (mtime — the rename that made the segment visible)."""
+        try:
+            return os.path.getmtime(os.path.join(self.path, name))
+        except OSError:
+            return 0.0
+
+
+class PrefixTail:
+    """Tail an object-store prefix of immutable TFRecord segments.
+
+    The S3 wire subset exposes no reliable server-side mtime, so publish
+    times are *first-seen* times observed by this tail — an upper bound on
+    event time, which keeps the watermark conservative (freshness lag is
+    never under-reported)."""
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+        self._store = get_store()
+        self._seen: dict[str, float] = {}
+
+    def list_segments(self) -> list[str]:
+        base = self.url + "/"
+        now = time.time()
+        out = []
+        for obj in self._store.list_prefix(base):
+            name = obj[len(base):]
+            if "/" in name or not name.endswith(_SEGMENT_SUFFIXES):
+                continue
+            if name.startswith((".", "_")):
+                continue
+            self._seen.setdefault(name, now)
+            out.append(name)
+        return sorted(out)
+
+    def open_segment(self, name: str) -> BinaryIO:
+        return self._store.open_read_resuming(join_url(self.url, name))
+
+    def segment_time(self, name: str) -> float:
+        return self._seen.get(name, 0.0)
+
+    def forget(self, name: str) -> None:
+        """Reader hint: ``name`` is permanently behind the cursor — its
+        first-seen record is no longer needed (the watermark is a monotone
+        max, so dropping history cannot move it backwards)."""
+        self._seen.pop(name, None)
+
+
+def open_tail(root: str) -> DirectoryTail | PrefixTail:
+    """The one switch between local-dir and object-prefix event logs."""
+    return PrefixTail(root) if is_url(root) else DirectoryTail(root)
+
+
+def append_segment(
+    root: str,
+    labels: Sequence[float],
+    ids: np.ndarray,
+    vals: np.ndarray,
+    *,
+    seq: int,
+) -> str:
+    """Publish one immutable segment of CTR events (producer side).
+
+    Atomic visibility: local segments are written to a ``_tmp`` name and
+    renamed into place; remote segments are a single PUT (objects appear
+    whole or not at all).  Returns the segment name."""
+    name = segment_name(seq)
+    records = [
+        serialize_ctr_example(float(labels[i]), ids[i], vals[i])
+        for i in range(len(labels))
+    ]
+    payload = b"".join(frame_record(r) for r in records)
+    if is_url(root):
+        get_store().put(join_url(root, name), payload)
+        return name
+    os.makedirs(root, exist_ok=True)
+    tmp = os.path.join(root, f"_tmp.{name}")
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, os.path.join(root, name))
+    return name
+
+
+class EventLogReader:
+    """Decode an event log into training mini-batches with cursor tracking.
+
+    Each yielded item is ``(batch, cursor)`` where ``batch`` is the standard
+    CTR host batch ({feat_ids [B,F], feat_vals [B,F], label [B]}) and
+    ``cursor`` is the position *after* consuming that batch — persisting it
+    and replaying from it yields exactly the remaining records.  Batches may
+    span segments; a trailing partial batch is held until more events arrive
+    (``follow=True``) or flushed at end-of-log (``follow=False``).
+    """
+
+    def __init__(
+        self,
+        source: DirectoryTail | PrefixTail,
+        *,
+        field_size: int,
+        batch_size: int,
+        poll_interval_secs: float = 0.2,
+    ):
+        self._source = source
+        self._fields = int(field_size)
+        self._batch = int(batch_size)
+        self._poll = float(poll_interval_secs)
+        self._watermark = 0.0
+        self._lock = threading.Lock()
+        # record counts of segments read to their end: segments are
+        # immutable, so a known-exhausted segment is skipped without
+        # re-opening it — otherwise every tail poll would re-read (and for
+        # a prefix tail, re-GET) the whole newest segment just to discard
+        # already-consumed records
+        self._counts: dict[str, int] = {}
+
+    def watermark(self) -> float:
+        """Publish time of the newest fully-consumed segment (0.0 before
+        any segment completes): every event at or before it has been read."""
+        with self._lock:
+            return self._watermark
+
+    def _records_from(self, cursor: StreamCursor) -> Iterator[tuple[bytes, StreamCursor]]:
+        """Raw records strictly after ``cursor`` among currently-listed
+        segments, each paired with the cursor that marks it consumed."""
+        for name in self._source.list_segments():
+            if cursor.advanced_past(name):
+                # fully behind the cursor forever (cursors are monotone):
+                # drop its bookkeeping so a long-lived tail's memory tracks
+                # the live window, not the log's age
+                self._counts.pop(name, None)
+                forget = getattr(self._source, "forget", None)
+                if forget is not None:
+                    forget(name)
+                continue
+            skip = cursor.record if name == cursor.segment else 0
+            known = self._counts.get(name)
+            if known is not None and skip >= known:
+                if skip > known:
+                    raise ValueError(
+                        f"segment {name!r} has {known} records but the "
+                        f"cursor claims {skip} consumed — segments must be "
+                        f"immutable"
+                    )
+                # fully consumed on a prior pass: nothing to read
+                self._bump_watermark(name)
+                continue
+            idx = 0
+            with self._source.open_segment(name) as f:
+                for rec in read_records(f):
+                    idx += 1
+                    if idx <= skip:
+                        continue
+                    yield rec, StreamCursor(segment=name, record=idx)
+            self._counts[name] = idx
+            if idx < skip:
+                # segment shrank?  immutability violated — fail loudly
+                # rather than silently rewinding the cursor
+                raise ValueError(
+                    f"segment {name!r} has {idx} records but the cursor "
+                    f"claims {skip} consumed — segments must be immutable"
+                )
+            self._bump_watermark(name)
+
+    def _bump_watermark(self, name: str) -> None:
+        with self._lock:
+            self._watermark = max(
+                self._watermark, self._source.segment_time(name)
+            )
+
+    def batches(
+        self,
+        cursor: StreamCursor = StreamCursor(),
+        *,
+        follow: bool = False,
+        stop: threading.Event | None = None,
+        idle_timeout_secs: float = 0.0,
+        max_batches: int = 0,
+    ) -> Iterator[tuple[dict, StreamCursor]]:
+        """Mini-batches from ``cursor`` onward.
+
+        ``follow=False`` reads the log as it stands and flushes a final
+        partial batch.  ``follow=True`` tails: at end-of-log it polls for
+        new segments every ``poll_interval_secs``, stopping on ``stop`` /
+        after ``idle_timeout_secs`` without new data (0 = never) /
+        after ``max_batches`` yielded (0 = unbounded).
+        """
+        buf: list[tuple[bytes, StreamCursor]] = []
+        yielded = 0
+        last_progress = time.time()
+        while True:
+            progressed = False
+            for rec, rec_cursor in self._records_from(
+                buf[-1][1] if buf else cursor
+            ):
+                buf.append((rec, rec_cursor))
+                progressed = True
+                if len(buf) >= self._batch:
+                    yield self._decode(buf)
+                    cursor = buf[-1][1]
+                    buf = []
+                    yielded += 1
+                    if max_batches and yielded >= max_batches:
+                        return
+                if stop is not None and stop.is_set():
+                    break
+            if progressed:
+                last_progress = time.time()
+            if stop is not None and stop.is_set():
+                break
+            if not follow:
+                break
+            if (idle_timeout_secs > 0
+                    and time.time() - last_progress >= idle_timeout_secs):
+                break
+            if stop is not None:
+                stop.wait(self._poll)
+            else:
+                time.sleep(self._poll)
+        if buf:
+            yield self._decode(buf)
+
+    def _decode(self, buf: list[tuple[bytes, StreamCursor]]) -> tuple[dict, StreamCursor]:
+        feats, labels = decode_ctr_batch((r for r, _ in buf), self._fields)
+        batch = {**feats, "label": labels}
+        return batch, buf[-1][1]

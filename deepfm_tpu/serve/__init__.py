@@ -6,4 +6,9 @@ from .export import (  # noqa: F401
     load_servable,
     write_predictions,
 )
+from .reload import (  # noqa: F401
+    HotSwapper,
+    SwappableParams,
+    load_swappable_servable,
+)
 from .server import Scorer, score_stdin, serve_forever  # noqa: F401

@@ -58,9 +58,33 @@ class OverloadedError(RuntimeError):
 def instances_to_arrays(
     instances: list[dict],
 ) -> tuple[np.ndarray, np.ndarray]:
-    """JSON ``instances`` rows -> ([N, F] int64 ids, [N, F] f32 vals)."""
-    ids = np.asarray([i["feat_ids"] for i in instances], np.int64)
-    vals = np.asarray([i["feat_vals"] for i in instances], np.float32)
+    """JSON ``instances`` rows -> ([N, F] int64 ids, [N, F] f32 vals).
+
+    Malformed rows raise ``ValueError`` with a row-indexed message (the
+    server maps ValueError to HTTP 400 — a client's bad request must never
+    read as a 500 outage)."""
+    ids_rows, val_rows = [], []
+    for n, inst in enumerate(instances):
+        if not isinstance(inst, dict):
+            raise ValueError(
+                f"instances[{n}] is {type(inst).__name__}, expected an "
+                f"object with 'feat_ids' and 'feat_vals'"
+            )
+        missing = [k for k in ("feat_ids", "feat_vals") if k not in inst]
+        if missing:
+            raise ValueError(
+                f"instances[{n}] is missing {missing} (has "
+                f"{sorted(inst)})"
+            )
+        ids_rows.append(inst["feat_ids"])
+        val_rows.append(inst["feat_vals"])
+    try:
+        ids = np.asarray(ids_rows, np.int64)
+        vals = np.asarray(val_rows, np.float32)
+    except (ValueError, TypeError) as e:
+        raise ValueError(
+            f"instances rows are ragged or non-numeric: {e}"
+        ) from None
     return ids, vals
 
 

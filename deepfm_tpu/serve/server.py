@@ -312,11 +312,17 @@ def _send_json(self, code: int, payload: dict) -> None:
     self.wfile.write(body)
 
 
-def make_handler(scorer, model_name: str):
+def make_handler(scorer, model_name: str, reload_status=None):
     """REST handler over any engine exposing score/score_instances —
     the micro-batching engine in production; the single-lock Scorer only
     in the benchmark baseline.  ``GET /v1/metrics`` serves the engine's
-    metrics snapshot when the engine provides one."""
+    metrics snapshot when the engine provides one.
+
+    ``reload_status`` (a zero-arg callable returning the HotSwapper status
+    dict, serve/reload.py) turns on hot-reload observability: the status
+    document and every predict response carry the live ``model_version``,
+    and ``/v1/metrics`` gains a ``reload`` section (version, weight
+    staleness, swap latency, rollback count)."""
     predict_path = f"/v1/models/{model_name}:predict"
     binary_path = f"/v1/models/{model_name}:predict_binary"
     status_path = f"/v1/models/{model_name}"
@@ -334,19 +340,23 @@ def make_handler(scorer, model_name: str):
 
         def do_GET(self):  # noqa: N802 (http.server API)
             if self.path == status_path:
+                version = "1"
+                if reload_status is not None:
+                    version = str(reload_status().get("model_version", 0))
                 self._send(
                     200,
                     {
                         "model_version_status": [
-                            {"version": "1", "state": "AVAILABLE"}
+                            {"version": version, "state": "AVAILABLE"}
                         ]
                     },
                 )
             elif (self.path == "/v1/metrics"
                   and hasattr(scorer, "metrics_snapshot")):
-                self._send(
-                    200, {"model": model_name, **scorer.metrics_snapshot()}
-                )
+                snap = {"model": model_name, **scorer.metrics_snapshot()}
+                if reload_status is not None:
+                    snap["reload"] = reload_status()
+                self._send(200, snap)
             else:
                 self._send(404, {"error": f"unknown path {self.path!r}"})
 
@@ -378,7 +388,16 @@ def make_handler(scorer, model_name: str):
             except Exception as e:
                 self._send(500, {"error": f"{type(e).__name__}: {e}"})
                 return
-            self._send(200, {"predictions": [float(p) for p in probs]})
+            doc = {"predictions": [float(p) for p in probs]}
+            if reload_status is not None:
+                # the engine's LIVE version at response-assembly time.  A
+                # request in flight across a swap may have scored on the
+                # previous version (at most one behind); per-dispatch
+                # attribution would have to thread through the coalescing
+                # engine — for exact score provenance compare against the
+                # published artifact (its manifest carries param_hash)
+                doc["model_version"] = reload_status().get("model_version", 0)
+            self._send(200, doc)
 
         def _predict_binary(self):
             # the gRPC-role analog, dependency-free: JSON encode/decode of
@@ -442,6 +461,7 @@ def serve_pool(
     host: str = "127.0.0.1", model_name: str = "deepfm",
     buckets=(8, 32, 128, 512), max_wait_ms: float = 2.0,
     max_queue_rows: int | None = None, item_corpus: str | None = None,
+    reload_url: str | None = None, reload_interval_secs: float = 2.0,
     max_restarts: int = 10,
     ready: threading.Event | None = None,
 ) -> None:
@@ -485,6 +505,11 @@ def serve_pool(
                     model_name=model_name, buckets=buckets,
                     max_wait_ms=max_wait_ms, max_queue_rows=max_queue_rows,
                     item_corpus=item_corpus,
+                    # each worker polls + swaps independently; versions are
+                    # committed marker-last, so workers converge without
+                    # coordination (briefly mixed versions during a rollout)
+                    reload_url=reload_url,
+                    reload_interval_secs=reload_interval_secs,
                 )
             except BaseException:
                 # the traceback is the only diagnostic a crash-looping
@@ -568,19 +593,31 @@ def serve_forever(
     model_name: str = "deepfm", buckets=(8, 32, 128, 512),
     max_wait_ms: float = 2.0, max_queue_rows: int | None = None,
     item_corpus: str | None = None,
+    reload_url: str | None = None, reload_interval_secs: float = 2.0,
     ready: threading.Event | None = None,
 ) -> None:
     """Serve whichever servable lives at ``servable_dir``: CTR models get
     ``:predict``; two-tower retrieval gets ``:encode_user``/``:encode_item``
     and — with ``item_corpus`` — ``:retrieve``.  Both ride the bucketed
     micro-batching engine (serve/batcher.py), precompiled before the
-    socket opens so the first request never pays a compile."""
+    socket opens so the first request never pays a compile.
+
+    ``reload_url`` (a publish root — local dir or object URL written by
+    ``online/publisher.py``) turns on zero-downtime hot weight reload: the
+    params ride the precompiled bucket executables as arguments, a
+    HotSwapper polls for new versions every ``reload_interval_secs``, and
+    swaps pass canary + drain before traffic sees them (serve/reload.py)."""
     import os
 
     from .export import _load_config, load_retrieval_servable, load_servable
 
     buckets = _parse_buckets(buckets)
     cfg = _load_config(os.path.abspath(servable_dir))
+    if reload_url and cfg.model.model_name == "two_tower":
+        raise ValueError(
+            "--reload-url supports CTR servables only (two-tower serving "
+            "has no hot-swap path yet)"
+        )
     if cfg.model.model_name == "two_tower":
         encode_user, encode_item, cfg = load_retrieval_servable(servable_dir)
         rscorer = RetrievalScorer(
@@ -599,13 +636,30 @@ def serve_forever(
                 f"--item-corpus only applies to two-tower servables; "
                 f"{servable_dir!r} holds {cfg.model.model_name!r}"
             )
-        predict, cfg = load_servable(servable_dir)
+        reload_status = None
+        if reload_url:
+            from .reload import HotSwapper, load_swappable_servable
+
+            predict, predict_with, holder, cfg = load_swappable_servable(
+                servable_dir
+            )
+            swapper = HotSwapper(
+                holder, predict_with, reload_url, cfg,
+                interval_secs=reload_interval_secs,
+            )
+            # adopt any already-published version BEFORE the socket opens,
+            # then poll in the background
+            swapper.poll_once()
+            swapper.start()
+            reload_status = swapper.status
+        else:
+            predict, cfg = load_servable(servable_dir)
         scorer = MicroBatcher(
             predict, cfg.model.field_size, buckets=buckets,
             max_wait_ms=max_wait_ms, max_queue_rows=max_queue_rows,
         )
         compiles = scorer.precompile()
-        handler = make_handler(scorer, model_name)
+        handler = make_handler(scorer, model_name, reload_status=reload_status)
         endpoint = "predict"
     print(f"precompiled bucket executables: {compiles}", file=sys.stderr)
     httpd = ScoringHTTPServer((host, port), handler)
@@ -730,6 +784,17 @@ def main(argv: list[str] | None = None) -> int:
         "--stdin", action="store_true",
         help="score stdin lines (libsvm or JSONL) instead of serving HTTP",
     )
+    ap.add_argument(
+        "--reload-url", default=None,
+        help="publish root (dir or object URL, online/publisher.py) to poll "
+             "for new model versions; new weights hot-swap under the "
+             "precompiled bucket executables with canary + drain — zero "
+             "downtime, zero recompiles",
+    )
+    ap.add_argument(
+        "--reload-interval", type=float, default=2.0,
+        help="seconds between manifest polls when --reload-url is set",
+    )
     args = ap.parse_args(argv)
     if args.stdin:
         score_stdin(args.servable, batch_size=args.batch_size,
@@ -742,6 +807,8 @@ def main(argv: list[str] | None = None) -> int:
             buckets=args.buckets, max_wait_ms=args.max_wait_ms,
             max_queue_rows=args.max_queue_rows,
             item_corpus=args.item_corpus,
+            reload_url=args.reload_url,
+            reload_interval_secs=args.reload_interval,
         )
         return 0
     serve_forever(
@@ -749,6 +816,8 @@ def main(argv: list[str] | None = None) -> int:
         model_name=args.model_name, buckets=args.buckets,
         max_wait_ms=args.max_wait_ms, max_queue_rows=args.max_queue_rows,
         item_corpus=args.item_corpus,
+        reload_url=args.reload_url,
+        reload_interval_secs=args.reload_interval,
     )
     return 0
 

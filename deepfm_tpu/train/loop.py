@@ -634,6 +634,13 @@ def run_task(cfg: Config):
     plus ``serve`` — online scoring over the exported servable (the
     TF-Serving step of the reference's workflow, serve/server.py)."""
     task = cfg.run.task_type
+    if task in ("online-train", "online_train"):
+        # continuous training from the event log at training_data_dir,
+        # publishing versioned servables the serve task hot-reloads
+        # (online/trainer.py; the online half of the train->serve loop)
+        from ..online.trainer import run_online_train
+
+        return run_online_train(cfg)
     if task == "serve":
         from ..serve.server import serve_forever, serve_pool
 
@@ -646,6 +653,8 @@ def run_task(cfg: Config):
                 buckets=cfg.run.serve_buckets,
                 max_wait_ms=cfg.run.serve_max_wait_ms,
                 item_corpus=cfg.run.serve_item_corpus or None,
+                reload_url=cfg.run.serve_reload_url or None,
+                reload_interval_secs=cfg.run.serve_reload_interval_secs,
             )
             return None
         serve_forever(
@@ -655,6 +664,8 @@ def run_task(cfg: Config):
             buckets=cfg.run.serve_buckets,
             max_wait_ms=cfg.run.serve_max_wait_ms,
             item_corpus=cfg.run.serve_item_corpus or None,
+            reload_url=cfg.run.serve_reload_url or None,
+            reload_interval_secs=cfg.run.serve_reload_interval_secs,
         )
         return None
     if cfg.model.model_name == "two_tower":

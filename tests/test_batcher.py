@@ -298,3 +298,28 @@ def test_score_after_close_raises(predict_cfg):
     front.close()
     with pytest.raises(RuntimeError, match="closed"):
         front.score(*_rows(2, seed=9))
+
+
+def test_instances_to_arrays_rejects_malformed_rows_as_value_error():
+    """Malformed JSON rows must raise ValueError (-> HTTP 400 with a clear,
+    row-indexed message), never a bare KeyError that reads as a 500."""
+    from deepfm_tpu.serve.batcher import instances_to_arrays
+
+    good = {"feat_ids": [1, 2, 3], "feat_vals": [0.1, 0.2, 0.3]}
+    ids, vals = instances_to_arrays([good, good])
+    assert ids.shape == (2, 3) and vals.dtype == np.float32
+
+    with pytest.raises(ValueError, match=r"instances\[1\] is missing.*feat_vals"):
+        instances_to_arrays([good, {"feat_ids": [1, 2, 3]}])
+    with pytest.raises(ValueError, match=r"instances\[0\].*feat_ids"):
+        instances_to_arrays([{"feat_vals": [0.1]}])
+    with pytest.raises(ValueError, match=r"instances\[1\] is int"):
+        instances_to_arrays([good, 7])
+    with pytest.raises(ValueError, match="ragged or non-numeric"):
+        instances_to_arrays(
+            [good, {"feat_ids": [1, 2], "feat_vals": [0.1, 0.2]}]
+        )
+    with pytest.raises(ValueError, match="ragged or non-numeric"):
+        instances_to_arrays(
+            [{"feat_ids": ["a", "b", "c"], "feat_vals": [0.1, 0.2, 0.3]}]
+        )

@@ -133,6 +133,8 @@ def test_serve_task_dispatch(monkeypatch):
         "buckets": "8,32,128,512",
         "max_wait_ms": 2.0,
         "item_corpus": None,
+        "reload_url": None,  # run.serve_reload_url="" -> hot reload off
+        "reload_interval_secs": 2.0,
     }
 
 

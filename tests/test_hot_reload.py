@@ -1,0 +1,377 @@
+"""Zero-downtime hot weight reload (serve/reload.py): swappable servables
+(params as executable arguments — swap == jit cache hit, no recompile),
+HotSwapper staging/canary/rollback, and the end-to-end acceptance drill:
+a live HTTP engine on version N takes version N+1 from the online trainer
+with concurrent predict traffic never failing, post-swap scores matching a
+fresh engine loaded directly from N+1, and /v1/metrics reporting the new
+version."""
+
+import json
+import os
+import threading
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from deepfm_tpu.core.config import Config
+from deepfm_tpu.online import ModelPublisher, OnlineTrainer, append_segment
+from deepfm_tpu.online.publisher import version_location
+from deepfm_tpu.serve import export_servable, load_servable
+from deepfm_tpu.serve.batcher import MicroBatcher
+from deepfm_tpu.serve.reload import (
+    HotSwapper,
+    SwappableParams,
+    load_swappable_servable,
+)
+from deepfm_tpu.serve.server import serve_forever
+from deepfm_tpu.train import create_train_state, make_train_step
+
+FEATURE, FIELD = 64, 5
+
+CFG = Config.from_dict(
+    {
+        "model": {
+            "feature_size": FEATURE,
+            "field_size": FIELD,
+            "embedding_size": 4,
+            "deep_layers": (8,),
+            "dropout_keep": (1.0,),
+            "compute_dtype": "float32",
+        },
+        "optimizer": {"learning_rate": 0.01},
+    }
+)
+
+
+def _rows(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.integers(0, FEATURE, (n, FIELD)).astype(np.int64),
+        rng.random((n, FIELD), dtype=np.float32),
+    )
+
+
+def _trained_state(steps, seed=0):
+    rng = np.random.default_rng(seed)
+    state = create_train_state(CFG)
+    step_fn = jax.jit(make_train_step(CFG))
+    for _ in range(steps):
+        batch = {
+            "feat_ids": rng.integers(0, FEATURE, (8, FIELD)),
+            "feat_vals": rng.random((8, FIELD), dtype=np.float32),
+            "label": (rng.random(8) < 0.3).astype(np.float32),
+        }
+        state, _ = step_fn(state, batch)
+    return state
+
+
+@pytest.fixture(scope="module")
+def servable_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("swap_servable")
+    export_servable(CFG, create_train_state(CFG), d)
+    return str(d)
+
+
+def test_swappable_servable_matches_static_load(servable_dir):
+    predict, predict_with, holder, cfg = load_swappable_servable(servable_dir)
+    static_predict, _ = load_servable(servable_dir)
+    ids, vals = _rows(8, seed=1)
+    np.testing.assert_allclose(
+        np.asarray(predict(ids, vals)),
+        np.asarray(static_predict(ids, vals)),
+        rtol=1e-6,
+    )
+    assert holder.version == 0
+
+
+def test_swap_is_a_cache_hit_not_a_recompile(servable_dir):
+    """The tentpole property: new weights ride the SAME executables.  After
+    precompiling the buckets, a swap must not trigger any new trace/compile
+    (counted via jax's cache stats on the jitted function)."""
+    predict, predict_with, holder, cfg = load_swappable_servable(servable_dir)
+    eng = MicroBatcher(predict, FIELD, buckets=(4, 8), max_wait_ms=0.5)
+    eng.precompile()
+    ids, vals = _rows(3, seed=2)
+    before = np.asarray(eng.score(ids, vals))
+    misses_before = predict_with._cache_size()
+
+    new_state = _trained_state(3, seed=3)
+    # explicit device, matching the boot payload's placement: committedness
+    # is part of the jit cache key (serve/reload.py)
+    payload = jax.device_put(
+        {"params": new_state.params, "model_state": new_state.model_state},
+        jax.devices()[0],
+    )
+    assert holder.swap(payload, version=1)
+    after = np.asarray(eng.score(ids, vals))
+    assert predict_with._cache_size() == misses_before, "swap recompiled"
+    assert not np.allclose(before, after), "swap did not change the weights"
+    eng.close()
+
+
+def test_swappable_params_drain_waits_for_inflight():
+    holder = SwappableParams({"w": np.zeros(2)}, version=0)
+    payload, gen = holder.acquire()
+    done = []
+
+    def do_swap():
+        done.append(holder.swap({"w": np.ones(2)}, version=1,
+                                drain_timeout_secs=10.0))
+
+    t = threading.Thread(target=do_swap)
+    t.start()
+    t.join(timeout=0.3)
+    assert t.is_alive(), "swap returned before the in-flight dispatch drained"
+    holder.release(gen)
+    t.join(timeout=10)
+    assert done == [True]
+    assert holder.version == 1
+    # timeout path: a wedged holder doesn't hang the swapper forever
+    _p, g2 = holder.acquire()
+    assert holder.swap({"w": np.full(2, 2.0)}, version=2,
+                       drain_timeout_secs=0.05) is False
+    holder.release(g2)
+
+
+def test_hot_swapper_canary_rolls_back_nan_weights(servable_dir, tmp_path):
+    predict, predict_with, holder, cfg = load_swappable_servable(servable_dir)
+    pub = ModelPublisher(str(tmp_path / "publish"))
+    bad_state = create_train_state(CFG)
+    bad_params = dict(bad_state.params)
+    bad_params["fm_v"] = np.full_like(
+        np.asarray(bad_params["fm_v"]), np.nan
+    )
+    bad_state = bad_state._replace(params=bad_params)
+    pub.publish(CFG, bad_state)
+
+    swapper = HotSwapper(
+        holder, predict_with, str(tmp_path / "publish"), cfg,
+        staging_dir=str(tmp_path / "staging"),
+    )
+    assert swapper.poll_once() is False
+    status = swapper.status()
+    assert status["rollbacks_total"] == 1
+    assert "non-finite" in status["last_error"]
+    assert holder.version == 0  # live weights untouched
+    ids, vals = _rows(4, seed=4)
+    assert np.isfinite(np.asarray(predict(ids, vals))).all()
+
+
+def test_hot_swapper_refuses_hash_mismatch(servable_dir, tmp_path):
+    predict, predict_with, holder, cfg = load_swappable_servable(servable_dir)
+    pub = ModelPublisher(str(tmp_path / "publish"))
+    manifest = pub.publish(CFG, _trained_state(2, seed=5))
+    # corrupt the published manifest's hash (stands in for a torn artifact)
+    path = os.path.join(
+        str(tmp_path / "publish"), f"MANIFEST-{manifest.version:08d}.json"
+    )
+    doc = json.load(open(path))
+    doc["param_hash"] = "0" * 64
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    swapper = HotSwapper(
+        holder, predict_with, str(tmp_path / "publish"), cfg,
+        staging_dir=str(tmp_path / "staging"),
+    )
+    assert swapper.poll_once() is False
+    assert "hash mismatch" in swapper.status()["last_error"]
+    assert holder.version == 0
+
+
+def test_hot_swapper_refuses_incompatible_tree(servable_dir, tmp_path):
+    """A version with different parameter shapes cannot ride the live
+    executables — refused with a redeploy pointer, not recompiled."""
+    predict, predict_with, holder, cfg = load_swappable_servable(servable_dir)
+    other_cfg = CFG.with_overrides(model={"embedding_size": 8})
+    pub = ModelPublisher(str(tmp_path / "publish"))
+    pub.publish(other_cfg, create_train_state(other_cfg))
+    swapper = HotSwapper(
+        holder, predict_with, str(tmp_path / "publish"), cfg,
+        staging_dir=str(tmp_path / "staging"),
+    )
+    assert swapper.poll_once() is False
+    assert "recompile" in swapper.status()["last_error"]
+    assert holder.version == 0
+
+
+def _post_predict(base, instances, timeout=30):
+    req = urllib.request.Request(
+        f"{base}:predict",
+        data=json.dumps({"instances": instances}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.load(r)
+
+
+def test_e2e_server_hot_swaps_under_concurrent_traffic(tmp_path):
+    """Acceptance: engine up on version N; the online trainer publishes
+    N+1; concurrent predicts never fail across the swap; post-swap scores
+    match a fresh engine loaded directly from N+1; /v1/metrics reports the
+    new model_version."""
+    root = str(tmp_path)
+    stream = os.path.join(root, "stream")
+    publish = os.path.join(root, "publish")
+    cfg = CFG.with_overrides(
+        data={"training_data_dir": stream, "batch_size": 8},
+        run={
+            "model_dir": os.path.join(root, "ckpt"),
+            "servable_model_dir": publish,
+            "checkpoint_every_steps": 2,
+            "online_publish_every_steps": 0,  # publish once, at stream end
+            "log_steps": 10_000,
+        },
+    )
+    servable = os.path.join(root, "servable_v0")
+    export_servable(cfg, create_train_state(cfg), servable)
+
+    ready = threading.Event()
+    t = threading.Thread(
+        target=serve_forever,
+        args=(servable,),
+        kwargs=dict(
+            port=0, model_name="deepfm", buckets=(4, 8), max_wait_ms=1.0,
+            reload_url=publish, reload_interval_secs=0.1, ready=ready,
+        ),
+        daemon=True,
+    )
+    t.start()
+    assert ready.wait(timeout=120), "server did not come up"
+    base = f"http://127.0.0.1:{ready.port}/v1/models/deepfm"
+
+    rng = np.random.default_rng(11)
+    probe = [
+        {
+            "feat_ids": rng.integers(0, FEATURE, FIELD).tolist(),
+            "feat_vals": rng.random(FIELD).round(4).tolist(),
+        }
+        for _ in range(3)
+    ]
+    v0 = _post_predict(base, probe)
+    assert v0["model_version"] == 0
+
+    # concurrent clients hammer :predict across the whole swap window
+    stop = threading.Event()
+    failures: list[str] = []
+    counts = [0]
+    lock = threading.Lock()
+
+    def client(seed):
+        crng = np.random.default_rng(seed)
+        inst = [
+            {
+                "feat_ids": crng.integers(0, FEATURE, FIELD).tolist(),
+                "feat_vals": crng.random(FIELD).round(4).tolist(),
+            }
+            for _ in range(2)
+        ]
+        while not stop.is_set():
+            try:
+                doc = _post_predict(base, inst, timeout=30)
+                assert len(doc["predictions"]) == 2
+                with lock:
+                    counts[0] += 1
+            except Exception as e:  # any failed request fails the test
+                failures.append(f"{type(e).__name__}: {e}")
+                return
+
+    clients = [
+        threading.Thread(target=client, args=(100 + i,), daemon=True)
+        for i in range(4)
+    ]
+    for c in clients:
+        c.start()
+
+    # publish version 1 from the online trainer while traffic flows
+    labels_ids_vals = np.random.default_rng(5)
+    for seq in range(2):
+        labels = (labels_ids_vals.random(8) < 0.3).astype(np.float32)
+        ids = labels_ids_vals.integers(0, FEATURE, (8, FIELD)).astype(np.int64)
+        vals = labels_ids_vals.random((8, FIELD)).astype(np.float32)
+        append_segment(stream, labels, ids, vals, seq=seq)
+    OnlineTrainer(cfg).run(follow=False)
+
+    # wait for the server to report the swap
+    import time
+
+    deadline = time.time() + 60
+    version = 0
+    while time.time() < deadline:
+        with urllib.request.urlopen(f"{base[: base.rfind('/v1/')]}"
+                                    "/v1/metrics", timeout=30) as r:
+            metrics = json.load(r)
+        version = metrics["reload"]["model_version"]
+        if version >= 1:
+            break
+        time.sleep(0.1)
+    assert version == 1, f"swap never surfaced in metrics: {metrics}"
+    assert metrics["reload"]["swaps_total"] >= 1
+    assert metrics["reload"]["rollbacks_total"] == 0
+    assert metrics["reload"]["weight_staleness_secs"] >= 0
+
+    # keep traffic flowing a beat past the swap, then stop the clients
+    time.sleep(0.3)
+    stop.set()
+    for c in clients:
+        c.join(timeout=30)
+    assert not failures, f"requests failed during the swap: {failures[:3]}"
+    assert counts[0] > 0, "clients never completed a request"
+
+    # post-swap scores match a fresh engine loaded directly from N+1
+    v1 = _post_predict(base, probe)
+    assert v1["model_version"] == 1
+    fresh_predict, _ = load_servable(version_location(publish, 1))
+    ids = np.asarray([i["feat_ids"] for i in probe], np.int64)
+    vals = np.asarray([i["feat_vals"] for i in probe], np.float32)
+    pad_i = np.concatenate([ids, np.zeros((1, FIELD), np.int64)])
+    pad_v = np.concatenate([vals, np.zeros((1, FIELD), np.float32)])
+    want = np.asarray(fresh_predict(pad_i, pad_v))[:3]  # same 4-bucket shape
+    np.testing.assert_allclose(v1["predictions"], want, rtol=1e-5)
+    # and they genuinely moved off version 0
+    assert not np.allclose(v1["predictions"], v0["predictions"])
+
+    # status document now reports the live version
+    with urllib.request.urlopen(base, timeout=30) as r:
+        status = json.load(r)
+    assert status["model_version_status"][0]["version"] == "1"
+
+
+def test_hot_swapper_over_object_store_publish_root(servable_dir, tmp_path):
+    """The train->serve transport over the S3-wire subset: publish versions
+    to an object-store prefix, stage + hash-verify + swap from it."""
+    from deepfm_tpu.utils.dev_object_store import serve as serve_store
+
+    root = tmp_path / "store_root"
+    (root / "bucket").mkdir(parents=True)
+    server, base = serve_store(str(root))
+    try:
+        url = f"{base}/bucket/publish"
+        pub = ModelPublisher(url, keep=2)
+        manifest = pub.publish(CFG, _trained_state(2, seed=21))
+        assert manifest.version == 1
+
+        predict, predict_with, holder, cfg = load_swappable_servable(
+            servable_dir
+        )
+        swapper = HotSwapper(
+            holder, predict_with, url, cfg,
+            staging_dir=str(tmp_path / "staging"),
+        )
+        assert swapper.poll_once() is True
+        assert holder.version == 1
+        assert swapper.status()["last_error"] is None
+        ids, vals = _rows(4, seed=22)
+        got = np.asarray(predict(ids, vals))
+        # staged-from-store weights score identically to the state that was
+        # published (loaded via the local version mirror in staging)
+        fresh_predict, _ = load_servable(
+            os.path.join(str(tmp_path / "staging"), f"{1:08d}")
+        )
+        np.testing.assert_allclose(
+            got, np.asarray(fresh_predict(ids, vals)), rtol=1e-6
+        )
+    finally:
+        server.shutdown()
+        server.server_close()

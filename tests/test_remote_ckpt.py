@@ -1,0 +1,261 @@
+"""RemoteCheckpointer failure paths (checkpoint/remote.py): transient
+upload errors retried with backoff, exhausted-retry steps re-enqueued on
+the next save until they gain a remote commit marker, failures surfaced on
+the subsequent save, uncommitted staging leftovers purged at init, and
+retention deleting marker-first."""
+
+import jax
+import numpy as np
+import pytest
+
+from deepfm_tpu.checkpoint.remote import _MARKER, RemoteCheckpointer
+from deepfm_tpu.core.config import Config
+from deepfm_tpu.data.object_store import HttpObjectStore, ObjectStoreError
+from deepfm_tpu.train import create_train_state, make_train_step
+from deepfm_tpu.utils.dev_object_store import serve
+
+CFG = Config.from_dict(
+    {
+        "model": {
+            "feature_size": 80,
+            "field_size": 4,
+            "embedding_size": 4,
+            "deep_layers": (8,),
+            "dropout_keep": (1.0,),
+            "compute_dtype": "float32",
+        },
+        "optimizer": {"learning_rate": 0.01},
+    }
+)
+
+
+class FlakyStore(HttpObjectStore):
+    """Store whose PUTs fail on demand — the transient-outage stand-in."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.fail_puts = 0
+        self.put_attempts = 0
+        self.put_urls: list[str] = []
+
+    def put(self, url, data):
+        self.put_attempts += 1
+        self.put_urls.append(url)
+        if self.fail_puts > 0:
+            self.fail_puts -= 1
+            raise ObjectStoreError(f"injected transient failure for {url}")
+        super().put(url, data)
+
+
+@pytest.fixture()
+def remote_env(tmp_path):
+    root = tmp_path / "store_root"
+    (root / "bucket").mkdir(parents=True)
+    server, base = serve(str(root))
+    store = FlakyStore(timeout=10)
+    yield f"{base}/bucket/model", store, tmp_path
+    server.shutdown()
+    server.server_close()
+
+
+def _ckptr(url, store, tmp_path, **kwargs):
+    rc = RemoteCheckpointer(
+        url, staging_dir=str(tmp_path / "staging"),
+        retry_backoff_secs=0.01, **kwargs,
+    )
+    rc._store = store
+    return rc
+
+
+def _states(n):
+    state = create_train_state(CFG)
+    step_fn = jax.jit(make_train_step(CFG))
+    rng = np.random.default_rng(0)
+    out = []
+    for _ in range(n):
+        batch = {
+            "feat_ids": rng.integers(0, 80, (8, 4)),
+            "feat_vals": rng.random((8, 4), dtype=np.float32),
+            "label": (rng.random(8) < 0.3).astype(np.float32),
+        }
+        state, _ = step_fn(state, batch)
+        out.append(state)
+    return out
+
+
+def test_transient_put_failure_retried_within_one_save(remote_env):
+    url, store, tmp = remote_env
+    rc = _ckptr(url, store, tmp, upload_retries=3)
+    (s1,) = _states(1)
+    store.fail_puts = 1  # first PUT of the tree fails once, then recovers
+    assert rc.save(s1, block=True)
+    assert rc._remote_steps() == [1]  # upload completed despite the failure
+    assert not rc._failed_steps
+    rc.close()
+
+
+def test_exhausted_retries_logged_on_next_save_and_reenqueued(remote_env, caplog):
+    url, store, tmp = remote_env
+    rc = _ckptr(url, store, tmp, upload_retries=2)
+    s1, s2 = _states(2)
+    # every attempt of step 1's upload fails: 2 retries x (many PUTs) — make
+    # the injector outlast both attempts' first PUT
+    store.fail_puts = 10_000
+    assert rc.save(s1)  # async kick-off; failure lands in the background
+    rc._uploader.join()
+    assert rc._failed_steps == {1}
+    store.fail_puts = 0  # outage over
+    # the next save LOGS the stored error (raising would skip this save and
+    # kill the uncatching train loop), saves locally, and re-enqueues the
+    # marker-less step 1 alongside the new step
+    import logging
+
+    with caplog.at_level(logging.WARNING):
+        assert rc.save(s2, block=True)
+    assert any("re-enqueued" in r.message for r in caplog.records)
+    assert rc._remote_steps() == [1, 2]
+    assert not rc._failed_steps
+    rc.close()
+
+
+def test_block_save_and_close_still_raise(remote_env):
+    """The explicit durability barriers keep raising: block=True surfaces
+    THIS save's failure; close surfaces a pending one."""
+    url, store, tmp = remote_env
+    rc = _ckptr(url, store, tmp, upload_retries=1)
+    (s1,) = _states(1)
+    store.fail_puts = 10_000
+    with pytest.raises(ObjectStoreError, match="injected"):
+        rc.save(s1, block=True)
+    store.fail_puts = 0
+    assert rc._failed_steps == {1}
+    rc.close()  # pending error already surfaced by the block=True save
+
+
+def test_committed_step_not_reuploaded_after_retention_failure(remote_env):
+    """A step whose upload failed only AFTER its commit marker landed (the
+    retention delete phase) is already durable — _pending_steps must not
+    re-enqueue its whole tree."""
+    url, store, tmp = remote_env
+    rc = _ckptr(url, store, tmp, upload_retries=1, max_to_keep=2)
+    s1, s2, s3, s4 = _states(4)
+    assert rc.save(s1, block=True)
+    assert rc.save(s2, block=True)
+    # poison step 3's RETENTION phase only (keep=2 forces a delete of step
+    # 1 right after step 3's marker lands): deletes fail, PUTs succeed
+    real_delete = HttpObjectStore.delete
+
+    def failing_delete(self_store, u):
+        raise ObjectStoreError(f"injected delete failure for {u}")
+
+    store.delete = failing_delete.__get__(store)
+    rc.save(s3)
+    rc._uploader.join()
+    assert 3 in rc._remote_steps()  # marker landed before the failure
+    assert rc._failed_steps == {3}
+    store.delete = real_delete.__get__(store)
+    # next save: step 3 is filtered out (already committed); no step-3
+    # object is re-uploaded
+    store.put_urls = []
+    rc.save(s4, block=True)
+    assert not rc._failed_steps
+    assert not any("/3/" in u or u.endswith("_COMMIT_3")
+                   for u in store.put_urls)
+    assert any("/4/" in u for u in store.put_urls)
+    assert rc._remote_steps() == [3, 4]
+    rc.close()
+
+
+def test_reenqueue_skips_steps_dropped_by_retention(remote_env):
+    """An extended outage spanning several saves: once local retention has
+    dropped a failed step, the re-enqueue stops retrying it; recovery
+    uploads exactly the surviving window."""
+    url, store, tmp = remote_env
+    rc = _ckptr(url, store, tmp, upload_retries=1, max_to_keep=2)
+    states = _states(4)
+    store.fail_puts = 10_000  # outage spans the first three saves
+    assert rc.save(states[0])
+    rc._uploader.join()
+    assert rc._failed_steps == {1}
+    assert rc.save(states[1])
+    rc._uploader.join()
+    assert rc.save(states[2])
+    rc._uploader.join()
+    # local retention (keep 2) has dropped step 1 by now; only the live
+    # window stays enqueued
+    assert 1 not in rc._pending_steps()
+    store.fail_puts = 0  # outage over
+    assert rc.save(states[3], block=True)
+    assert not rc._failed_steps
+    assert rc._remote_steps() == [3, 4]
+    rc.close()
+
+
+def test_uncommitted_staging_steps_purged_at_init(remote_env):
+    url, store, tmp = remote_env
+    rc = _ckptr(url, store, tmp)
+    s1, s2 = _states(2)
+    rc.save(s1, block=True)
+    rc.save(s2, block=True)
+    rc.close()
+    # simulate a crash mid-upload: step 2's remote marker vanishes (tree
+    # may be partial); the local staging copy must not resurrect it
+    store.delete(f"{url}/{_MARKER}2")
+    rc2 = _ckptr(url, store, tmp)
+    assert rc2.latest_step() == 1
+    import os
+
+    assert not os.path.isdir(str(tmp / "staging" / "2"))
+    restored = rc2.restore(create_train_state(CFG))
+    assert int(restored.step) == 1
+    rc2.close()
+
+
+def test_retention_deletes_marker_first(remote_env):
+    """Remote retention order: the marker goes before the tree, so a crash
+    mid-delete leaves an unreadable (invisible) step, never a half one."""
+    url, store, tmp = remote_env
+    deletes = []
+    real_delete = store.delete
+
+    def tracking_delete(u):
+        deletes.append(u)
+        real_delete(u)
+
+    store.delete = tracking_delete
+    rc = _ckptr(url, store, tmp, max_to_keep=2)
+    for s in _states(3):
+        rc.save(s, block=True)
+    assert rc._remote_steps() == [2, 3]
+    # step 1's deletion sequence: marker strictly before any tree object
+    marker_idx = deletes.index(f"{url}/{_MARKER}1")
+    tree_idxs = [
+        i for i, u in enumerate(deletes) if u.startswith(f"{url}/1/")
+    ]
+    assert tree_idxs and all(marker_idx < i for i in tree_idxs)
+    rc.close()
+
+
+def test_upload_failure_does_not_corrupt_remote_index(remote_env):
+    """A step that never gained its marker is invisible to readers even
+    though tree objects may exist remotely."""
+    url, store, tmp = remote_env
+    rc = _ckptr(url, store, tmp, upload_retries=1)
+    (s1,) = _states(1)
+
+    # fail ONLY the marker PUT: the tree uploads, the commit never lands
+    real_put = HttpObjectStore.put
+
+    def marker_failing_put(self_store, u, data):
+        if _MARKER in u:
+            raise ObjectStoreError(f"injected marker failure for {u}")
+        real_put(self_store, u, data)
+
+    store.put = marker_failing_put.__get__(store)
+    rc.save(s1)
+    rc._uploader.join()
+    assert rc._remote_steps() == []  # no marker => not committed
+    assert rc._failed_steps == {1}
+    with pytest.raises(ObjectStoreError, match="injected marker"):
+        rc.close()  # close surfaces the pending failure too
+    rc.close()
