@@ -1,0 +1,367 @@
+"""Chaos recovery benchmark: scripted storage faults against the live
+train→publish→serve loop, measuring what an outage actually costs.
+
+Three scenarios, all on the dev object store's deterministic FaultPlan
+(utils/dev_object_store.py) and CPU-friendly:
+
+  * **publish_put_500s** — versioned publish while every PUT eats a burst
+    of 500s.  Measures publish latency clean vs faulted (the retry tax)
+    and verifies the committed artifact is whole (manifest hash check).
+  * **poll_outage** — a serving engine with hot reload polls a publish
+    root through a full store outage (default 10 s: LIST/GET all 503)
+    while closed-loop clients score the whole time.  Measures requests
+    failed during the outage (the design claim: ZERO — old weights keep
+    serving), the breaker open/close timeline, and recovery latency from
+    store-heal to the pending version being live.
+  * **mid_body_truncation** — event-log segment reads where GETs serve
+    ~40% of the body then cut the connection.  Measures read wall time
+    clean vs truncated (the resume tax) and verifies zero data loss and
+    zero quarantines.
+
+Persists docs/BENCH_CHAOS.json ({latest, runs}).
+
+Run:  JAX_PLATFORMS=cpu python benchmarks/chaos_recovery.py --persist
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import _bench_util as bu
+
+V, F = 2000, 13
+
+
+def _cfg(stream_root: str, ckpt_root: str, publish_root: str):
+    from deepfm_tpu.core.config import Config
+
+    return Config.from_dict({
+        "model": {
+            "feature_size": V,
+            "field_size": F,
+            "embedding_size": 8,
+            "deep_layers": (32, 16),
+            "dropout_keep": (1.0, 1.0),
+            "compute_dtype": "float32",
+        },
+        "optimizer": {"learning_rate": 0.01},
+        "data": {"training_data_dir": stream_root, "batch_size": 32},
+        "run": {
+            "model_dir": ckpt_root,
+            "servable_model_dir": publish_root,
+            "checkpoint_every_steps": 2,
+            "online_publish_every_steps": 2,
+            "log_steps": 10_000_000,
+        },
+    })
+
+
+def _fill_stream(root: str, *, segments: int, rows: int = 64, seed0=0):
+    from deepfm_tpu.online import append_segment
+
+    for seq in range(segments):
+        rng = np.random.default_rng(seed0 + seq)
+        labels = (rng.random(rows) < 0.3).astype(np.float32)
+        ids = rng.integers(0, V, (rows, F)).astype(np.int64)
+        vals = rng.random((rows, F)).astype(np.float32)
+        append_segment(root, labels, ids, vals, seq=seq)
+
+
+# ------------------------------------------------------------- scenario 1
+
+
+def scenario_publish_put_500s(base: str, plan, cfg, state, *, faults: int):
+    from deepfm_tpu.online import ModelPublisher
+    from deepfm_tpu.online.publisher import param_tree_hash, read_manifest
+
+    url = f"{base}/bucket/bench_publish"
+    pub = ModelPublisher(url, keep=4)
+
+    pub.publish(cfg, state)  # warmup: export-path compiles land here
+    t0 = time.perf_counter()
+    pub.publish(cfg, state)
+    clean_s = time.perf_counter() - t0
+
+    fired_before = plan.fired_total
+    plan.set_rules([{"verb": "PUT", "key": "bucket/bench_publish/*",
+                     "times": faults, "status": 500}])
+    t0 = time.perf_counter()
+    manifest = pub.publish(cfg, state)
+    faulted_s = time.perf_counter() - t0
+    plan.clear()
+
+    whole = (read_manifest(url, manifest.version).param_hash
+             == param_tree_hash(state.params, state.model_state))
+    return {
+        "injected_put_500s": faults,
+        "faults_consumed": plan.fired_total - fired_before,
+        "publish_clean_s": round(clean_s, 3),
+        "publish_faulted_s": round(faulted_s, 3),
+        "retry_tax_s": round(faulted_s - clean_s, 3),
+        "artifact_whole": bool(whole),
+        "ok": bool(whole),
+    }
+
+
+# ------------------------------------------------------------- scenario 2
+
+
+def scenario_poll_outage(base: str, plan, cfg, *, outage_s: float,
+                         clients: int, root: str):
+    from deepfm_tpu.online import ModelPublisher
+    from deepfm_tpu.serve.batcher import MicroBatcher
+    from deepfm_tpu.serve.export import export_servable
+    from deepfm_tpu.serve.reload import HotSwapper, load_swappable_servable
+    from deepfm_tpu.train import create_train_state
+    from deepfm_tpu.utils.retry import CircuitBreaker
+
+    url = f"{base}/bucket/bench_poll"
+    pub = ModelPublisher(url, keep=4)
+    servable = os.path.join(root, "servable_outage")
+    export_servable(cfg, create_train_state(cfg), servable)
+    predict, predict_with, holder, scfg = load_swappable_servable(servable)
+    engine = MicroBatcher(predict, F, buckets=(4, 16), max_wait_ms=1.0)
+    engine.precompile()
+    breaker = CircuitBreaker(failure_threshold=0.5, window=6, min_calls=3,
+                             cooldown_secs=2.0, name="reload")
+    swapper = HotSwapper(
+        holder, predict_with, url, scfg, interval_secs=0.1,
+        staging_dir=os.path.join(root, "staging_outage"), breaker=breaker,
+    )
+
+    stop = threading.Event()
+    ok_counts = [0] * clients
+    outage_fail_counts = [0] * clients
+    outage_window = [0.0, float("inf")]  # [start, end) wall-clock
+
+    def client(i):
+        rng = np.random.default_rng(300 + i)
+        ids = rng.integers(0, V, (2, F)).astype(np.int64)
+        vals = rng.random((2, F)).astype(np.float32)
+        while not stop.is_set():
+            try:
+                engine.score(ids, vals)
+                ok_counts[i] += 1
+            except Exception:
+                now = time.time()
+                if outage_window[0] <= now < outage_window[1]:
+                    outage_fail_counts[i] += 1
+
+    timeline: list[tuple[float, str]] = []
+
+    def observe(t_start):
+        last = None
+        while not stop.is_set():
+            s = breaker.state
+            if s != last:
+                timeline.append((round(time.time() - t_start, 3), s))
+                last = s
+            time.sleep(0.01)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(clients)]
+    t_start = time.time()
+    threads.append(threading.Thread(target=observe, args=(t_start,),
+                                    daemon=True))
+    for t in threads:
+        t.start()
+    swapper.start()
+
+    time.sleep(1.0)  # healthy warmup
+    # outage: the store vanishes for the reload path
+    outage_window[0] = time.time()
+    plan.set_rules([
+        {"verb": "LIST", "key": "bucket/bench_poll*", "status": 503},
+        {"verb": "GET", "key": "bucket/bench_poll/*", "status": 503},
+    ])
+    # a fresher model is published elsewhere during the outage (the publish
+    # path here is a different store client wearing no faults: rules match
+    # the poll root only after the publisher's writes... so publish first
+    # half-way through, under the same 503s it would just retry forever —
+    # instead stage the publish AFTER the heal, which is the realistic
+    # "backlog drains once storage returns" shape)
+    time.sleep(outage_s)
+    plan.clear()
+    heal_t = time.time()
+    outage_window[1] = heal_t
+    pub.publish(cfg, create_train_state(cfg))
+    pub_done_t = time.time()
+
+    # recovery: time from heal to the published version LIVE on the engine;
+    # publish_to_live_s strips the publish itself (export + upload) out so
+    # the swap machinery's share is visible
+    deadline = time.time() + 60
+    while holder.version < 1 and time.time() < deadline:
+        time.sleep(0.01)
+    live_t = time.time() if holder.version >= 1 else None
+
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    swapper.stop()
+    engine.close()
+    status = swapper.status()
+    return {
+        "outage_s": outage_s,
+        "clients": clients,
+        "requests_ok_total": int(sum(ok_counts)),
+        "requests_failed_during_outage": int(sum(outage_fail_counts)),
+        "poll_errors_total": status["poll_errors_total"],
+        "polls_skipped_total": status["polls_skipped_total"],
+        "breaker_open_total": status["breaker"]["open_total"],
+        "breaker_timeline": [
+            {"t_s": t, "state": s} for t, s in timeline
+        ],
+        "recovery_latency_s": (round(live_t - heal_t, 3)
+                               if live_t is not None else None),
+        "publish_to_live_s": (round(live_t - pub_done_t, 3)
+                              if live_t is not None else None),
+        "final_version": holder.version,
+        "ok": bool(sum(outage_fail_counts) == 0 and holder.version >= 1
+                   and status["breaker"]["open_total"] >= 1),
+    }
+
+
+# ------------------------------------------------------------- scenario 3
+
+
+def scenario_mid_body_truncation(base: str, plan, *, segments: int,
+                                 rows: int, truncations: int):
+    from deepfm_tpu.online import EventLogReader, PrefixTail
+
+    url = f"{base}/bucket/bench_trunc"
+    _fill_stream(url, segments=segments, rows=rows, seed0=50)
+    expect = segments * rows
+
+    def read_all():
+        reader = EventLogReader(PrefixTail(url), field_size=F,
+                                batch_size=rows)
+        t0 = time.perf_counter()
+        n = sum(it[0]["label"].shape[0]
+                for it in reader.batches(follow=False))
+        return time.perf_counter() - t0, n, reader.stats()
+
+    clean_s, clean_n, _ = read_all()
+    fired_before = plan.fired_total
+    plan.set_rules([{"verb": "GET", "key": "bucket/bench_trunc/*",
+                     "times": truncations, "truncate": 0.4}])
+    faulted_s, faulted_n, stats = read_all()
+    consumed = plan.fired_total - fired_before
+    plan.clear()
+    return {
+        "segments": segments,
+        "rows_expected": expect,
+        "injected_truncations": truncations,
+        "truncations_consumed": consumed,
+        "read_clean_s": round(clean_s, 3),
+        "read_faulted_s": round(faulted_s, 3),
+        "resume_tax_s": round(faulted_s - clean_s, 3),
+        "rows_clean": clean_n,
+        "rows_faulted": faulted_n,
+        "segments_quarantined": stats["segments_quarantined"],
+        "ok": bool(clean_n == expect and faulted_n == expect
+                   and stats["segments_quarantined"] == 0),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--outage", type=float, default=10.0,
+                    help="store outage duration for the poll scenario")
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--put-faults", type=int, default=6,
+                    help="injected PUT 500s for the publish scenario")
+    ap.add_argument("--truncations", type=int, default=6)
+    ap.add_argument("--persist", action="store_true")
+    args = ap.parse_args()
+
+    from deepfm_tpu.core.platform import sanitize_backend
+
+    sanitize_backend()
+    from deepfm_tpu.data.object_store import HttpObjectStore, set_store
+    from deepfm_tpu.train import create_train_state
+    from deepfm_tpu.utils.dev_object_store import serve
+    from deepfm_tpu.utils.retry import RetryPolicy
+
+    platform, device = bu.backend_platform()
+    root = tempfile.mkdtemp(prefix="chaos_recovery_")
+    os.makedirs(os.path.join(root, "store", "bucket"))
+    server, base = serve(os.path.join(root, "store"))
+    plan = server.fault_plan
+    # benchmark client: production-shaped retry policy, just less sleepy
+    prev = set_store(HttpObjectStore(
+        timeout=30,
+        retry=RetryPolicy(max_attempts=4, base_delay_secs=0.05,
+                          max_delay_secs=0.5, rng=random.Random(0)),
+    ))
+    try:
+        cfg = _cfg(os.path.join(root, "stream"), os.path.join(root, "ckpt"),
+                   f"{base}/bucket/bench_publish")
+        state = create_train_state(cfg)
+
+        print("scenario 1/3: publish under PUT 500 bursts", file=sys.stderr)
+        s1 = scenario_publish_put_500s(base, plan, cfg, state,
+                                       faults=args.put_faults)
+        print("scenario 2/3: 10s store outage under live serving",
+              file=sys.stderr)
+        s2 = scenario_poll_outage(base, plan, cfg, outage_s=args.outage,
+                                  clients=args.clients, root=root)
+        print("scenario 3/3: mid-body truncation on stream reads",
+              file=sys.stderr)
+        s3 = scenario_mid_body_truncation(base, plan, segments=4, rows=64,
+                                          truncations=args.truncations)
+    finally:
+        set_store(prev)
+        server.shutdown()
+        server.server_close()
+
+    out = {
+        "bench": "chaos_recovery",
+        "platform": platform,
+        "device": device,
+        "config": {
+            "outage_s": args.outage,
+            "clients": args.clients,
+            "put_faults": args.put_faults,
+            "truncations": args.truncations,
+            "model": {"feature_size": V, "field_size": F},
+        },
+        "scenarios": {
+            "publish_put_500s": s1,
+            "poll_outage": s2,
+            "mid_body_truncation": s3,
+        },
+        "note": (
+            "dev object store + FaultPlan on localhost: latencies measure "
+            "the retry/breaker machinery, not network distance.  The "
+            "poll-outage claim is the serving invariant: zero failed "
+            "predicts while the weight supply is dark, breaker opens to "
+            "stop the retry storm, pending version goes live within "
+            "recovery_latency_s of the store healing."
+        ),
+    }
+    print(json.dumps(out, indent=2))
+    ok = int(s1["ok"] and s2["ok"] and s3["ok"])
+    if args.persist:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "..", "docs", "BENCH_CHAOS.json")
+        bu.persist_latest_runs(os.path.normpath(path), out, ok=ok,
+                               platform=platform)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -110,8 +110,16 @@ class RemoteCheckpointer:
         self._is_writer = jax.process_index() == 0
         self._uploader: threading.Thread | None = None
         self._upload_err: BaseException | None = None
-        self._upload_retries = max(1, int(upload_retries))
-        self._retry_backoff = float(retry_backoff_secs)
+        # step-level retry tier on top of the store's own per-op retries:
+        # one schedule (bounded attempts, full-jitter backoff) instead of
+        # the ad-hoc loop this module used to carry
+        from ..utils.retry import RetryPolicy
+
+        self._upload_policy = RetryPolicy(
+            max_attempts=max(1, int(upload_retries)),
+            base_delay_secs=float(retry_backoff_secs),
+            max_delay_secs=max(float(retry_backoff_secs), 30.0),
+        )
         # steps whose upload exhausted its retries: re-enqueued on the next
         # save() so a transient outage costs latency, not a lost checkpoint
         self._failed_steps: set[int] = set()
@@ -208,19 +216,12 @@ class RemoteCheckpointer:
 
     def _upload_with_retries(self, step: int) -> None:
         """Bounded retry-with-backoff for transient object-store errors —
-        one flaky PUT must not orphan a whole checkpoint step."""
-        import time
-
-        delay = self._retry_backoff
-        for attempt in range(self._upload_retries):
-            try:
-                self._upload_step(step)
-                return
-            except Exception:
-                if attempt == self._upload_retries - 1:
-                    raise
-                time.sleep(delay)
-                delay *= 2
+        one flaky PUT must not orphan a whole checkpoint step.  The whole
+        step upload re-runs (uploads are idempotent full-object PUTs and
+        the marker is written last, so a re-run converges); any exception
+        counts as transient here because the local tree is known-good."""
+        self._upload_policy.call(lambda: self._upload_step(step),
+                                 classify=lambda e: True)
 
     def wait_until_finished(self) -> None:
         self._local.wait_until_finished()

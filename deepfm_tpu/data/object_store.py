@@ -62,28 +62,75 @@ def join_url(base: str, *parts: str) -> str:
 
 
 class ObjectStoreError(IOError):
-    pass
+    """Store-layer failure with enough structure to classify it.
+
+    ``status`` is the HTTP status code when one was received (None for
+    connection-level failures), ``url`` the object URL, and ``retryable``
+    the transient/permanent verdict: connection errors and 5xx/429 are
+    transient (retry them), any other 4xx is a caller/state error that a
+    retry cannot fix (fail fast)."""
+
+    def __init__(self, msg: str, *, status: int | None = None,
+                 url: str | None = None, retryable: bool = True):
+        super().__init__(msg)
+        self.status = status
+        self.url = url
+        self.retryable = retryable
+
+
+def _retryable_status(code: int) -> bool:
+    return code >= 500 or code == 429
+
+
+def _is_transient(exc: BaseException) -> bool:
+    """Retry verdict for a failed store operation: structured store errors
+    carry it; bare socket/HTTP-protocol errors mid-body are transient."""
+    if isinstance(exc, ObjectStoreError):
+        return exc.retryable
+    return isinstance(exc, (OSError, http.client.HTTPException))
+
+
+def _default_retry_policy():
+    from ..utils.retry import RetryPolicy
+
+    return RetryPolicy(max_attempts=4, base_delay_secs=0.1,
+                       max_delay_secs=2.0)
 
 
 class HttpObjectStore:
     """Stateless S3-wire-subset client.  One instance is shared freely
-    across threads (urllib openers are thread-safe)."""
+    across threads (urllib openers are thread-safe).
 
-    def __init__(self, *, timeout: float = 60.0):
+    Every verb runs under ``retry`` (bounded attempts, full-jitter
+    exponential backoff — utils/retry.py): connection errors and 5xx/429
+    responses re-attempt, other 4xx fail fast.  Blind re-execution is safe
+    on this API surface: GET/HEAD/LIST are reads, DELETE is idempotent, and
+    PUT always carries the FULL object (the S3 model — no partial writes),
+    so a re-PUT converges to the same committed object."""
+
+    def __init__(self, *, timeout: float = 60.0, retry=None):
         self._timeout = timeout
+        self._retry = _default_retry_policy() if retry is None else retry
 
     # -- plumbing ----------------------------------------------------------
     def _request(self, method: str, url: str, *, data: bytes | None = None,
                  headers: dict | None = None):
+        """One attempt, no retry — classification happens here."""
         req = urllib.request.Request(
             url, data=data, method=method, headers=headers or {})
         try:
             return urllib.request.urlopen(req, timeout=self._timeout)
         except urllib.error.HTTPError as e:
             raise ObjectStoreError(
-                f"{method} {url} -> HTTP {e.code} {e.reason}") from e
+                f"{method} {url} -> HTTP {e.code} {e.reason}",
+                status=e.code, url=url,
+                retryable=_retryable_status(e.code)) from e
         except urllib.error.URLError as e:
-            raise ObjectStoreError(f"{method} {url} -> {e.reason}") from e
+            raise ObjectStoreError(f"{method} {url} -> {e.reason}",
+                                   url=url, retryable=True) from e
+
+    def _retrying(self, fn):
+        return self._retry.call(fn, classify=_is_transient)
 
     # -- data path ---------------------------------------------------------
     def open_read(self, url: str, *, offset: int = 0) -> BinaryIO:
@@ -94,7 +141,8 @@ class HttpObjectStore:
         ``read(n)``), i.e. silent truncation.  Data-plane consumers use
         :meth:`open_read_resuming` instead."""
         headers = {"Range": f"bytes={offset}-"} if offset else {}
-        return self._request("GET", url, headers=headers)
+        return self._retrying(
+            lambda: self._request("GET", url, headers=headers))
 
     def open_read_resuming(self, url: str, *, offset: int = 0,
                            max_resumes: int = 5) -> "ResumingStream":
@@ -106,39 +154,76 @@ class HttpObjectStore:
                               max_resumes=max_resumes)
 
     def get(self, url: str) -> bytes:
-        with self._request("GET", url) as r:
-            return r.read()
+        # body read inside the retried closure: a connection dropped
+        # mid-body re-fetches the whole (bounded-size) object
+        def _get() -> bytes:
+            with self._request("GET", url) as r:
+                return r.read()
+
+        return self._retrying(_get)
 
     def put(self, url: str, data: bytes) -> None:
-        with self._request("PUT", url, data=data):
-            pass
+        # full-object PUT is idempotent: blind re-PUT converges
+        def _put() -> None:
+            with self._request("PUT", url, data=data):
+                pass
+
+        self._retrying(_put)
 
     def put_stream(self, url: str, fileobj, length: int) -> None:
         """PUT a seekable/readable body without materializing it: urllib
-        streams a file-like ``data`` when Content-Length is explicit."""
-        with self._request("PUT", url, data=fileobj,
-                           headers={"Content-Length": str(length)}):
-            pass
+        streams a file-like ``data`` when Content-Length is explicit.
+        Retries rewind seekable bodies; a non-seekable body (pipe) gets
+        exactly one attempt — its bytes are gone after a failure.  Seek
+        support is duck-probed (SpooledTemporaryFile predates the full
+        io ABC: no ``seekable()`` until 3.11)."""
+        try:
+            start = (fileobj.tell()
+                     if callable(getattr(fileobj, "seek", None)) else None)
+        except OSError:
+            start = None
+
+        def _put() -> None:
+            if start is not None:
+                fileobj.seek(start)
+            with self._request("PUT", url, data=fileobj,
+                               headers={"Content-Length": str(length)}):
+                pass
+
+        if start is None:
+            _put()
+        else:
+            self._retrying(_put)
 
     def exists(self, url: str) -> bool:
         try:
-            with self._request("HEAD", url):
-                return True
+            def _head() -> None:
+                with self._request("HEAD", url):
+                    pass
+
+            self._retrying(_head)
+            return True
         except ObjectStoreError as e:
-            if "HTTP 404" in str(e):
+            if e.status == 404:
                 return False
             raise
 
     def size(self, url: str) -> int:
-        with self._request("HEAD", url) as r:
-            return int(r.headers["Content-Length"])
+        def _size() -> int:
+            with self._request("HEAD", url) as r:
+                return int(r.headers["Content-Length"])
+
+        return self._retrying(_size)
 
     def delete(self, url: str) -> None:
         try:
-            with self._request("DELETE", url):
-                pass
+            def _delete() -> None:
+                with self._request("DELETE", url):
+                    pass
+
+            self._retrying(_delete)
         except ObjectStoreError as e:
-            if "HTTP 404" not in str(e):
+            if e.status != 404:
                 raise
 
     # -- listing -----------------------------------------------------------
@@ -153,8 +238,12 @@ class HttpObjectStore:
             if token:
                 q["continuation-token"] = token
             url = f"{endpoint}/{bucket}?{urllib.parse.urlencode(q)}"
-            with self._request("GET", url) as r:
-                root = ET.fromstring(r.read())
+
+            def _page(url=url) -> bytes:
+                with self._request("GET", url) as r:
+                    return r.read()
+
+            root = ET.fromstring(self._retrying(_page))
             # tolerate both namespaced (real S3) and bare (dev server) XML
             ns = root.tag.partition("}")[0] + "}" if "}" in root.tag else ""
             for c in root.iter(f"{ns}Contents"):
@@ -216,6 +305,12 @@ class ResumingStream:
     this, a dropped connection reads as clean EOF under sized reads and an
     epoch silently truncates — worse, a drop landing exactly on a TFRecord
     boundary is undetectable by framing alone.
+
+    The ``max_resumes`` budget bounds CONSECUTIVE no-progress resumes, not
+    resumes over the whole body: a resume that delivers new bytes resets
+    the budget, so a long stream on a flaky link survives arbitrarily many
+    drops as long as each reconnect makes progress, while a dead object
+    (every resume stalls at the same offset) still fails fast.
     """
 
     def __init__(self, store: HttpObjectStore, url: str, *,
@@ -257,6 +352,9 @@ class ResumingStream:
                 continue
             if chunk:
                 self._offset += len(chunk)
+                # progress: reset the resume budget (it bounds consecutive
+                # stalls at one offset, not total drops over the body)
+                self._resumes = 0
                 return chunk
             if self._total is None or self._offset >= self._total:
                 return b""  # genuine end of object
@@ -283,6 +381,15 @@ def get_store() -> HttpObjectStore:
     if _DEFAULT_STORE is None:
         _DEFAULT_STORE = HttpObjectStore()
     return _DEFAULT_STORE
+
+
+def set_store(store: HttpObjectStore | None) -> HttpObjectStore | None:
+    """Swap the process-default store (chaos tests install one with a fast
+    zero-sleep retry policy).  Returns the previous store; pass it back to
+    restore."""
+    global _DEFAULT_STORE
+    prev, _DEFAULT_STORE = _DEFAULT_STORE, store
+    return prev
 
 
 def open_source(src: str, *, offset: int = 0) -> BinaryIO:

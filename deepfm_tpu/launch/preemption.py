@@ -152,25 +152,46 @@ def run_with_restarts(
     *,
     max_restarts: int = 0,
     backoff_secs: float = 5.0,
+    max_backoff_secs: float = 120.0,
     on_restart: Callable[[int, BaseException], None] | None = None,
+    sleep: Callable[[float], None] = time.sleep,
+    rng=None,
 ) -> T:
     """Run ``fn``, retrying after crashes up to ``max_restarts`` times.
 
     ``PreemptedError`` and ``KeyboardInterrupt`` propagate immediately (the
     sender owns the reschedule); any other exception triggers a retry after
-    ``backoff_secs``.  Each retry resumes from the latest checkpoint because
-    the train tasks restore on startup.
-    """
-    attempt = 0
-    while True:
-        try:
-            return fn()
-        except (PreemptedError, KeyboardInterrupt):
-            raise
-        except Exception as e:
-            attempt += 1
-            if attempt > max_restarts:
-                raise
-            if on_restart is not None:
-                on_restart(attempt, e)
-            time.sleep(backoff_secs)
+    a backoff.  Each retry resumes from the latest checkpoint because the
+    train tasks restore on startup.
+
+    The backoff is **exponential with jitter**, starting at ``backoff_secs``
+    and doubling per consecutive crash up to ``max_backoff_secs``; each wait
+    is drawn uniformly from [cap/2, cap] ("equal jitter": desynchronizes
+    hosts that crashed on the same cause — a fixed delay would have a whole
+    fleet hammer shared storage in lockstep on every retry — while keeping
+    a floor so the storage actually gets a rest).  ``sleep``/``rng`` are
+    injectable for tests (no real waits in tier-1).
+
+    One backoff engine, not two: this delegates to
+    :class:`~deepfm_tpu.utils.retry.RetryPolicy` (``jitter="equal"``);
+    ``PreemptedError`` is classified non-retryable and
+    ``KeyboardInterrupt`` is not an ``Exception``, so both propagate
+    untouched."""
+    import random as _random
+
+    from ..utils.retry import RetryPolicy
+
+    policy = RetryPolicy(
+        max_attempts=max_restarts + 1,
+        base_delay_secs=backoff_secs,
+        max_delay_secs=max_backoff_secs,
+        jitter="equal",
+        sleep=sleep,
+        rng=rng if rng is not None else _random.Random(),
+    )
+    return policy.call(
+        fn,
+        classify=lambda e: not isinstance(e, PreemptedError),
+        on_retry=(None if on_restart is None
+                  else lambda attempt, e, delay: on_restart(attempt, e)),
+    )

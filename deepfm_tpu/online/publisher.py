@@ -159,13 +159,26 @@ def fetch_version(root: str, version: int, staging_dir: str) -> str:
 # -- write side -------------------------------------------------------------
 
 class ModelPublisher:
-    """Single-writer publisher of versioned servable artifacts."""
+    """Single-writer publisher of versioned servable artifacts.
 
-    def __init__(self, root: str, *, keep: int = 3):
+    Remote publishes run under ``retry`` (bounded attempts, jittered
+    backoff — utils/retry.py) as a WHOLE: each re-attempt first clears the
+    orphaned ``versions/<v>/`` prefix a failed attempt left behind, then
+    re-uploads the tree and re-PUTs the manifest last, so a half-uploaded
+    tree can never mix stale objects into the committed version (the
+    reader's param-hash check would reject it forever)."""
+
+    def __init__(self, root: str, *, keep: int = 3, retry=None):
         if keep < 1:
             raise ValueError(f"keep must be >= 1, got {keep}")
         self.root = root.rstrip("/") if is_url(root) else root
         self._keep = keep
+        if retry is None:
+            from ..utils.retry import RetryPolicy
+
+            retry = RetryPolicy(max_attempts=3, base_delay_secs=0.2,
+                                max_delay_secs=2.0)
+        self._retry = retry
         if not is_url(self.root):
             os.makedirs(self.root, exist_ok=True)
 
@@ -206,21 +219,38 @@ class ModelPublisher:
         if is_url(self.root):
             import tempfile
 
-            # clear any orphan objects from a crash after a previous upload
-            # of this version number (numbers come from committed manifests
-            # only): a stale extra object mixed into the fresh tree would
-            # fail the reader's param-hash check forever
-            get_store().delete_prefix(
-                version_location(self.root, version) + "/"
-            )
+            from ..data.object_store import ObjectStoreError
+
+            loc = version_location(self.root, version)
             with tempfile.TemporaryDirectory(prefix="deepfm_publish_") as tmp:
                 export_servable(cfg, state, tmp)
-                get_store().upload_tree(
-                    tmp, version_location(self.root, version)
+
+                def _attempt() -> None:
+                    # a prior attempt's manifest PUT may have COMMITTED
+                    # server-side with only the response lost: delete the
+                    # manifest before touching the tree, so no reader can
+                    # resolve this version while its tree is torn down and
+                    # rebuilt (manifest-last on the way in, manifest-first
+                    # on the way back out — same invariant as retention)
+                    get_store().delete(_manifest_path(self.root, version))
+                    # then clear orphan objects — from a previous crashed
+                    # run of this version number (numbers come from
+                    # committed manifests only) or from THIS publish's
+                    # failed prior attempt: a stale extra object mixed into
+                    # the fresh tree would fail the reader's param-hash
+                    # check forever
+                    get_store().delete_prefix(loc + "/")
+                    get_store().upload_tree(tmp, loc)
+                    get_store().put(
+                        _manifest_path(self.root, version),
+                        manifest.to_json().encode(),
+                    )
+
+                self._retry.call(
+                    _attempt,
+                    classify=lambda e: (not isinstance(e, ObjectStoreError)
+                                        or e.retryable),
                 )
-            get_store().put(
-                _manifest_path(self.root, version), manifest.to_json().encode()
-            )
         else:
             dest = version_location(self.root, version)
             shutil.rmtree(dest, ignore_errors=True)  # orphan from a crash

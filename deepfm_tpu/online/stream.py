@@ -195,6 +195,7 @@ class EventLogReader:
         field_size: int,
         batch_size: int,
         poll_interval_secs: float = 0.2,
+        max_segment_failures: int = 3,
     ):
         self._source = source
         self._fields = int(field_size)
@@ -208,6 +209,17 @@ class EventLogReader:
         # a prefix tail, re-GET) the whole newest segment just to discard
         # already-consumed records
         self._counts: dict[str, int] = {}
+        # segment quarantine (follow mode): a segment whose read keeps
+        # failing AFTER the store layer's own retries/resumes is retried on
+        # ``max_segment_failures`` consecutive polls (ordering preserved —
+        # later segments wait), then quarantined: skipped with a metric so
+        # one poisoned object degrades completeness, never liveness.  In
+        # one-shot mode (follow=False) read errors stay loud instead.
+        self._max_segment_failures = max(1, int(max_segment_failures))
+        self._fail_counts: dict[str, int] = {}
+        self._quarantined: set[str] = set()
+        self.segments_quarantined_total = 0
+        self.read_failures_total = 0
 
     def watermark(self) -> float:
         """Publish time of the newest fully-consumed segment (0.0 before
@@ -215,18 +227,69 @@ class EventLogReader:
         with self._lock:
             return self._watermark
 
-    def _records_from(self, cursor: StreamCursor) -> Iterator[tuple[bytes, StreamCursor]]:
+    def stats(self) -> dict:
+        """Fault-handling observability: quarantine + failure counters
+        (``quarantined`` lists only segments not yet behind the cursor —
+        the set is pruned as the cursor passes; the total is monotone)."""
+        with self._lock:
+            return {
+                "read_failures_total": self.read_failures_total,
+                "segments_quarantined": self.segments_quarantined_total,
+                "quarantined": sorted(self._quarantined),
+            }
+
+    def _note_read_failure(self, name: str, err: BaseException) -> bool:
+        """Record one failed read of ``name``; True once it crossed the
+        quarantine threshold (callers then skip it instead of retrying)."""
+        import logging
+
+        with self._lock:
+            self.read_failures_total += 1
+            n = self._fail_counts.get(name, 0) + 1
+            self._fail_counts[name] = n
+            quarantine = n >= self._max_segment_failures
+            if quarantine:
+                self._quarantined.add(name)
+                self.segments_quarantined_total += 1
+                self._fail_counts.pop(name, None)
+        log = logging.getLogger(__name__)
+        if quarantine:
+            log.warning(
+                "segment %s quarantined after %d failed reads "
+                "(skipping it; last error: %s)", name, n, err)
+        else:
+            log.warning(
+                "segment %s read failed (%d/%d before quarantine): %s",
+                name, n, self._max_segment_failures, err)
+        return quarantine
+
+    def _records_from(self, cursor: StreamCursor, *,
+                      suppress_errors: bool = False,
+                      ) -> Iterator[tuple[bytes, StreamCursor]]:
         """Raw records strictly after ``cursor`` among currently-listed
-        segments, each paired with the cursor that marks it consumed."""
+        segments, each paired with the cursor that marks it consumed.
+
+        ``suppress_errors`` (follow mode) turns a failed segment read into
+        a retry-next-poll (this listing pass stops there so ordering holds)
+        and, past the quarantine threshold, a permanent skip.  One-shot
+        mode (``suppress_errors=False``) neither skips quarantined
+        segments nor feeds the quarantine: its errors stay loud — silent
+        omission on the batch/oracle path would be data loss."""
         for name in self._source.list_segments():
             if cursor.advanced_past(name):
                 # fully behind the cursor forever (cursors are monotone):
-                # drop its bookkeeping so a long-lived tail's memory tracks
-                # the live window, not the log's age
+                # drop its bookkeeping — including quarantine membership —
+                # so a long-lived tail's memory tracks the live window, not
+                # the log's age
                 self._counts.pop(name, None)
+                with self._lock:
+                    self._fail_counts.pop(name, None)
+                    self._quarantined.discard(name)
                 forget = getattr(self._source, "forget", None)
                 if forget is not None:
                     forget(name)
+                continue
+            if suppress_errors and name in self._quarantined:
                 continue
             skip = cursor.record if name == cursor.segment else 0
             known = self._counts.get(name)
@@ -241,12 +304,46 @@ class EventLogReader:
                 self._bump_watermark(name)
                 continue
             idx = 0
-            with self._source.open_segment(name) as f:
-                for rec in read_records(f):
-                    idx += 1
-                    if idx <= skip:
-                        continue
-                    yield rec, StreamCursor(segment=name, record=idx)
+            try:
+                with self._source.open_segment(name) as f:
+                    for rec in read_records(f):
+                        idx += 1
+                        if idx <= skip:
+                            continue
+                        yield rec, StreamCursor(segment=name, record=idx)
+            except OSError as e:
+                # the store layer already retried (policy) and resumed
+                # (ResumingStream): reaching here means the object is
+                # persistently unreadable right now.  Records yielded
+                # before the failure carry valid cursors — nothing torn.
+                if not suppress_errors:
+                    # loud mode: count the failure but do NOT feed the
+                    # quarantine — a later follow-mode tail must not skip
+                    # a segment that only ever failed loudly
+                    with self._lock:
+                        self.read_failures_total += 1
+                    raise
+                if idx > skip:
+                    # this pass delivered NEW records before failing: the
+                    # quarantine budget bounds consecutive zero-progress
+                    # polls, not total failures over a big segment on a
+                    # degraded link (same principle as ResumingStream's
+                    # progress-reset resume budget) — the next poll resumes
+                    # from the advanced cursor
+                    import logging
+
+                    with self._lock:
+                        self.read_failures_total += 1
+                        self._fail_counts.pop(name, None)
+                    logging.getLogger(__name__).warning(
+                        "segment %s read failed after yielding %d new "
+                        "records (will resume next poll): %s",
+                        name, idx - skip, e)
+                    return
+                if self._note_read_failure(name, e):
+                    continue  # skip-with-metric; later segments proceed
+                return  # stop this pass; retry the segment next poll
+            self._fail_counts.pop(name, None)
             self._counts[name] = idx
             if idx < skip:
                 # segment shrank?  immutability violated — fail loudly
@@ -285,20 +382,33 @@ class EventLogReader:
         last_progress = time.time()
         while True:
             progressed = False
-            for rec, rec_cursor in self._records_from(
-                buf[-1][1] if buf else cursor
-            ):
-                buf.append((rec, rec_cursor))
-                progressed = True
-                if len(buf) >= self._batch:
-                    yield self._decode(buf)
-                    cursor = buf[-1][1]
-                    buf = []
-                    yielded += 1
-                    if max_batches and yielded >= max_batches:
-                        return
-                if stop is not None and stop.is_set():
-                    break
+            try:
+                for rec, rec_cursor in self._records_from(
+                    buf[-1][1] if buf else cursor,
+                    suppress_errors=follow,
+                ):
+                    buf.append((rec, rec_cursor))
+                    progressed = True
+                    if len(buf) >= self._batch:
+                        yield self._decode(buf)
+                        cursor = buf[-1][1]
+                        buf = []
+                        yielded += 1
+                        if max_batches and yielded >= max_batches:
+                            return
+                    if stop is not None and stop.is_set():
+                        break
+            except OSError as e:
+                # a failed LIST (store outage) — in follow mode the tailer
+                # outlives the outage and re-polls; one-shot reads stay loud
+                if not follow:
+                    raise
+                import logging
+
+                with self._lock:
+                    self.read_failures_total += 1
+                logging.getLogger(__name__).warning(
+                    "event-log poll failed (will retry): %s", e)
             if progressed:
                 last_progress = time.time()
             if stop is not None and stop.is_set():

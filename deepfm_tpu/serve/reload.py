@@ -49,6 +49,7 @@ from ..online.publisher import (
     latest_manifest,
     param_tree_hash,
 )
+from ..utils.retry import CircuitBreaker
 from .export import _load_config, _restore_payload
 
 
@@ -172,7 +173,15 @@ def load_swappable_servable(
 
 
 class HotSwapper:
-    """Poll a publish root and swap new versions under live executables."""
+    """Poll a publish root and swap new versions under live executables.
+
+    The store-facing half of every poll (manifest discovery, artifact
+    fetch) runs behind a circuit breaker: a store outage opens the circuit
+    after ``breaker`` sees enough failures, polls are then *skipped* (one
+    probe per cooldown instead of a full retry storm per tick) while the
+    old weights keep serving, and the first successful probe closes it
+    again.  Breaker state is surfaced in ``status()`` → ``/v1/metrics``'s
+    ``reload.breaker`` and flips ``/readyz`` while open."""
 
     def __init__(
         self,
@@ -185,6 +194,7 @@ class HotSwapper:
         canary_rows: int = 8,
         staging_dir: str | None = None,
         drain_timeout_secs: float = 30.0,
+        breaker: CircuitBreaker | None = None,
     ):
         self._holder = holder
         self._predict_with = predict_with
@@ -210,9 +220,16 @@ class HotSwapper:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # cooldown spans several poll ticks so an open circuit actually
+        # rests the store instead of probing every interval
+        self._breaker = breaker if breaker is not None else CircuitBreaker(
+            failure_threshold=0.5, window=6, min_calls=3,
+            cooldown_secs=max(5.0, 4.0 * self._interval), name="reload",
+        )
         self.swaps_total = 0
         self.rollbacks_total = 0
         self.poll_errors_total = 0
+        self.polls_skipped_total = 0
         self.last_swap_ms: float | None = None
         self.last_check_unix: float | None = None
         self.last_error: str | None = None
@@ -221,22 +238,45 @@ class HotSwapper:
     def poll_once(self) -> bool:
         """Check for a newer committed version; stage+canary+swap it.
         Returns True when a swap happened.  Never raises: a bad VERSION is
-        rolled back (``rollbacks_total``), while a failure merely
-        *discovering* versions (a flaky list/read, no candidate staged) is
-        a poll error (``poll_errors_total``) — conflating the two would
-        make transient store hiccups read as failing canaries."""
+        rolled back (``rollbacks_total``); a failure merely *discovering or
+        fetching* versions (a flaky list/read, no candidate staged) is a
+        poll error (``poll_errors_total``) feeding the circuit breaker —
+        conflating the two would make transient store hiccups read as
+        failing canaries.  While the breaker is open the poll is skipped
+        outright (``polls_skipped_total``): an outage costs one probe per
+        cooldown, not a retry storm per tick, and old weights keep
+        serving."""
         self.last_check_unix = time.time()
+        if not self._breaker.allow():
+            with self._lock:
+                self.polls_skipped_total += 1
+            return False
         try:
             manifest = latest_manifest(self._source)
         except Exception as e:
+            self._breaker.record_failure()
             with self._lock:
                 self.poll_errors_total += 1
                 self.last_error = f"poll: {type(e).__name__}: {e}"
             return False
         if manifest is None or manifest.version <= self._holder.version:
+            self._breaker.record_success()
             return False
         try:
-            payload = self._stage(manifest)
+            local = fetch_version(
+                self._source, manifest.version, self._staging
+            )
+        except Exception as e:
+            # store-facing fetch: an outage here is a poll error + breaker
+            # food, NOT a rollback — nothing was ever a swap candidate
+            self._breaker.record_failure()
+            with self._lock:
+                self.poll_errors_total += 1
+                self.last_error = f"stage: {type(e).__name__}: {e}"
+            return False
+        self._breaker.record_success()
+        try:
+            payload = self._stage(manifest, local)
             self._canary_check(payload)
             t0 = time.perf_counter()
             drained = self._holder.swap(
@@ -256,27 +296,48 @@ class HotSwapper:
                 self.last_error = f"{type(e).__name__}: {e}"
             return False
 
-    def _stage(self, manifest):
-        """Restore the version host-side, verify integrity + compatibility,
-        and commit it to device — all before any traffic can touch it."""
-        local = fetch_version(self._source, manifest.version, self._staging)
-        served_cfg = _load_config(local)
+    def _purge_staged(self, local: str) -> None:
+        """Drop a corruption-shaped artifact from the version-keyed staging
+        cache: fetch_version skips present dirs, so a torn copy left in
+        place would make every future poll re-fail on it forever."""
+        if os.path.abspath(local).startswith(
+                os.path.abspath(self._staging) + os.sep):
+            import shutil
+
+            shutil.rmtree(local, ignore_errors=True)
+
+    def _stage(self, manifest, local: str):
+        """Restore the (already fetched) version host-side, verify
+        integrity + compatibility, and commit it to device — all before any
+        traffic can touch it."""
+        try:
+            # failures in this block are corruption-shaped (a torn fetch
+            # that raced a publisher rebuild: missing config, unreadable
+            # payload, wrong bytes) — purge the cached copy so the next
+            # poll re-fetches.  Semantic refusals below (field size, tree
+            # shape, canary) keep the cache: re-downloading an artifact
+            # that is whole but incompatible would be pure churn.
+            served_cfg = _load_config(local)
+            model = get_model(served_cfg.model)
+            params, model_state = _restore_payload(
+                local,
+                lambda: model.init(jax.random.PRNGKey(0), served_cfg.model),
+            )
+            got = param_tree_hash(params, model_state)
+            if manifest.param_hash and got != manifest.param_hash:
+                raise ValueError(
+                    f"version {manifest.version} param hash mismatch "
+                    f"(manifest {manifest.param_hash[:12]}…, staged "
+                    f"{got[:12]}…) — torn or corrupted artifact"
+                )
+        except Exception:
+            self._purge_staged(local)
+            raise
         if served_cfg.model.field_size != self._cfg.model.field_size:
             raise ValueError(
                 f"version {manifest.version} has field_size "
                 f"{served_cfg.model.field_size}, engine serves "
                 f"{self._cfg.model.field_size} — not hot-swappable"
-            )
-        model = get_model(served_cfg.model)
-        params, model_state = _restore_payload(
-            local, lambda: model.init(jax.random.PRNGKey(0), served_cfg.model)
-        )
-        got = param_tree_hash(params, model_state)
-        if manifest.param_hash and got != manifest.param_hash:
-            raise ValueError(
-                f"version {manifest.version} param hash mismatch "
-                f"(manifest {manifest.param_hash[:12]}…, staged {got[:12]}…)"
-                " — torn or corrupted artifact"
             )
         new = {"params": params, "model_state": model_state}
         live = self._holder.get()
@@ -344,6 +405,8 @@ class HotSwapper:
                 "swaps_total": self.swaps_total,
                 "rollbacks_total": self.rollbacks_total,
                 "poll_errors_total": self.poll_errors_total,
+                "polls_skipped_total": self.polls_skipped_total,
+                "breaker": self._breaker.status(),
                 "last_swap_ms": self.last_swap_ms,
                 "last_check_unix": self.last_check_unix,
                 "last_error": self.last_error,

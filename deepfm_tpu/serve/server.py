@@ -210,7 +210,15 @@ def make_retrieval_handler(scorer: RetrievalScorer, model_name: str):
         _send = _send_json
 
         def do_GET(self):  # noqa: N802
-            if self.path == base:
+            if self.path == "/healthz":
+                self._send(200, {"status": "alive"})
+            elif self.path == "/readyz":
+                # retrieval servables have no reload path: ready once the
+                # engines precompiled (which happened before the socket
+                # opened)
+                self._send(200, {"ready": True, "engine_compiled": True,
+                                 "weights_loaded": True})
+            elif self.path == base:
                 self._send(
                     200,
                     {
@@ -312,7 +320,8 @@ def _send_json(self, code: int, payload: dict) -> None:
     self.wfile.write(body)
 
 
-def make_handler(scorer, model_name: str, reload_status=None):
+def make_handler(scorer, model_name: str, reload_status=None,
+                 readiness=None):
     """REST handler over any engine exposing score/score_instances —
     the micro-batching engine in production; the single-lock Scorer only
     in the benchmark baseline.  ``GET /v1/metrics`` serves the engine's
@@ -322,7 +331,15 @@ def make_handler(scorer, model_name: str, reload_status=None):
     dict, serve/reload.py) turns on hot-reload observability: the status
     document and every predict response carry the live ``model_version``,
     and ``/v1/metrics`` gains a ``reload`` section (version, weight
-    staleness, swap latency, rollback count)."""
+    staleness, swap latency, rollback count).
+
+    ``GET /healthz`` is liveness (the process answers), ``GET /readyz``
+    readiness (engine compiled + weights loaded + reloader not
+    open-circuit — 503 otherwise, so load balancers rotate a worker whose
+    weight supply is broken out before it serves stale scores silently);
+    ``readiness`` is a zero-arg callable returning the readiness doc with
+    a boolean ``ready`` key (default: ready once the handler exists, which
+    is after precompile)."""
     predict_path = f"/v1/models/{model_name}:predict"
     binary_path = f"/v1/models/{model_name}:predict_binary"
     status_path = f"/v1/models/{model_name}"
@@ -339,7 +356,14 @@ def make_handler(scorer, model_name: str, reload_status=None):
         _send = _send_json
 
         def do_GET(self):  # noqa: N802 (http.server API)
-            if self.path == status_path:
+            if self.path == "/healthz":
+                self._send(200, {"status": "alive"})
+            elif self.path == "/readyz":
+                doc = (readiness() if readiness is not None
+                       else {"ready": True, "engine_compiled": True,
+                             "weights_loaded": True})
+                self._send(200 if doc.get("ready") else 503, doc)
+            elif self.path == status_path:
                 version = "1"
                 if reload_status is not None:
                     version = str(reload_status().get("model_version", 0))
@@ -478,6 +502,11 @@ def serve_pool(
     socket so ``port=0`` resolves once and every worker binds the same
     resolved port.  Workers are forked BEFORE jax/servable load, so each
     child initializes its own runtime (fork-safety).
+
+    ``GET /healthz``/``/readyz`` ride the shared port like every other
+    route: the kernel picks a worker per probe, so repeated probes sample
+    the pool — a worker whose reload breaker is open answers 503 on
+    ``/readyz`` while the rest keep answering 200.
     """
     import os
     import signal
@@ -659,7 +688,25 @@ def serve_forever(
             max_wait_ms=max_wait_ms, max_queue_rows=max_queue_rows,
         )
         compiles = scorer.precompile()
-        handler = make_handler(scorer, model_name, reload_status=reload_status)
+
+        def readiness():
+            # the handler exists only after load + precompile, so those
+            # legs are tautologically true; the live signal is the
+            # reloader's circuit — open means the weight supply is broken
+            # (store outage) and this worker may be serving stale scores
+            doc = {"ready": True, "engine_compiled": True,
+                   "weights_loaded": True}
+            if reload_status is not None:
+                st = reload_status()
+                breaker = st.get("breaker") or {}
+                doc["model_version"] = st.get("model_version")
+                doc["reload_breaker"] = breaker.get("state", "closed")
+                doc["ready"] = breaker.get("state") != "open"
+            return doc
+
+        handler = make_handler(scorer, model_name,
+                               reload_status=reload_status,
+                               readiness=readiness)
         endpoint = "predict"
     print(f"precompiled bucket executables: {compiles}", file=sys.stderr)
     httpd = ScoringHTTPServer((host, port), handler)

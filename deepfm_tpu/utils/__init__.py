@@ -1,1 +1,6 @@
 from .logging import MetricLogger  # noqa: F401
+from .retry import (  # noqa: F401
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryPolicy,
+)

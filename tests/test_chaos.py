@@ -1,0 +1,652 @@
+"""Storage-fault injection across the train→publish→serve pipeline.
+
+Every test scripts an exact failure sequence through the dev store's
+:class:`~deepfm_tpu.utils.dev_object_store.FaultPlan` (500/503/429 bursts,
+connection drops, mid-body truncation, whole-store outages) and asserts the
+hardened consumers survive it: the object store retries transient errors,
+the publisher re-attempts with orphan cleanup, the stream reader
+quarantines poisoned segments without wedging the tailer, the HotSwapper's
+circuit breaker converts an outage into skipped polls while old weights
+keep serving, and (slow e2e) live predict traffic never fails while the
+store misbehaves and trainer crash-resume under checkpoint-upload faults
+stays bit-exact."""
+
+import json
+import os
+import random
+import shutil
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from deepfm_tpu.core.config import Config
+from deepfm_tpu.data.object_store import (
+    HttpObjectStore,
+    ObjectStoreError,
+    set_store,
+)
+from deepfm_tpu.online import (
+    EventLogReader,
+    ModelPublisher,
+    OnlineTrainer,
+    PrefixTail,
+    append_segment,
+    latest_manifest,
+    list_versions,
+    segment_name,
+)
+from deepfm_tpu.online.publisher import param_tree_hash, read_manifest
+from deepfm_tpu.online.trainer import replay_to_state
+from deepfm_tpu.utils.dev_object_store import serve
+from deepfm_tpu.utils.retry import RetryPolicy
+
+pytestmark = pytest.mark.chaos
+
+FEATURE, FIELD = 64, 5
+
+
+def _cfg(stream_root, ckpt_root, publish_root, **run_overrides):
+    run = {
+        "model_dir": ckpt_root,
+        "servable_model_dir": publish_root,
+        "checkpoint_every_steps": 2,
+        "online_publish_every_steps": 2,
+        "log_steps": 10_000,
+    }
+    run.update(run_overrides)
+    return Config.from_dict(
+        {
+            "model": {
+                "feature_size": FEATURE,
+                "field_size": FIELD,
+                "embedding_size": 4,
+                "deep_layers": (8,),
+                "dropout_keep": (1.0,),
+                "compute_dtype": "float32",
+            },
+            "optimizer": {"learning_rate": 0.01},
+            "data": {"training_data_dir": stream_root, "batch_size": 8},
+            "run": run,
+        }
+    )
+
+
+def _fill_stream(root, *, segments, rows=8, seed0=0):
+    for seq in range(segments):
+        rng = np.random.default_rng(seed0 + seq)
+        labels = (rng.random(rows) < 0.3).astype(np.float32)
+        ids = rng.integers(0, FEATURE, (rows, FIELD)).astype(np.int64)
+        vals = rng.random((rows, FIELD)).astype(np.float32)
+        append_segment(root, labels, ids, vals, seq=seq)
+
+
+@pytest.fixture()
+def chaos_store(tmp_path):
+    """Dev store + process-default client with a fast (near-zero-sleep)
+    retry policy, so chaos tests exercise the retry LOGIC without paying
+    production backoff waits."""
+    root = tmp_path / "store_root"
+    (root / "bucket").mkdir(parents=True)
+    server, base = serve(str(root))
+    fast = HttpObjectStore(
+        timeout=10,
+        retry=RetryPolicy(max_attempts=4, base_delay_secs=0.01,
+                          max_delay_secs=0.05, rng=random.Random(0)),
+    )
+    prev = set_store(fast)
+    yield server.fault_plan, base, fast
+    set_store(prev)
+    server.shutdown()
+    server.server_close()
+
+
+# ------------------------------------------------------- fault-plan control
+
+
+def test_fault_control_endpoint_roundtrip(chaos_store):
+    """The POST /__faults__ wire API: set rules remotely, observe firing
+    counters, clear."""
+    plan, base, store = chaos_store
+    body = json.dumps({
+        "seed": 7,
+        "rules": [{"verb": "GET", "key": "bucket/ctl", "times": 1,
+                   "status": 500}],
+    }).encode()
+    req = urllib.request.Request(f"{base}/__faults__", data=body,
+                                 method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert json.load(r)["ok"] is True
+
+    store.put(f"{base}/bucket/ctl", b"x")
+    assert store.get(f"{base}/bucket/ctl") == b"x"  # 1 injected 500, retried
+    with urllib.request.urlopen(f"{base}/__faults__", timeout=10) as r:
+        doc = json.load(r)
+    assert doc["fired_total"] == 1
+    assert doc["rules"][0]["times"] == 0
+
+    req = urllib.request.Request(f"{base}/__faults__", method="DELETE")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        json.load(r)
+    with urllib.request.urlopen(f"{base}/__faults__", timeout=10) as r:
+        assert json.load(r)["rules"] == []
+
+
+def test_fault_probability_is_seeded_reproducible(chaos_store):
+    plan, base, store = chaos_store
+    store.put(f"{base}/bucket/p", b"x")
+
+    def firings(seed):
+        plan.set_rules(
+            [{"verb": "HEAD", "key": "bucket/p", "probability": 0.5}],
+            seed=seed,
+        )
+        out = []
+        for _ in range(12):
+            before = plan.fired_total
+            store.exists(f"{base}/bucket/p")
+            out.append(plan.fired_total - before)
+        return out
+
+    a, b = firings(3), firings(3)
+    assert a == b, "same seed must script the same fault sequence"
+    assert 0 < sum(a) < sum([1] * 12)  # actually probabilistic
+
+
+# ------------------------------------------------------------- publisher
+
+
+def test_publisher_retries_whole_publish_with_orphan_cleanup(chaos_store, tmp_path):
+    """Manifest-last publish under PUT faults with a NO-retry store client:
+    the publisher's own retry tier must clean the orphaned versions/<v>/
+    prefix and re-attempt until the manifest commits."""
+    from deepfm_tpu.train import create_train_state
+
+    plan, base, _ = chaos_store
+    # disable the store-level tier so the publisher tier is what's tested
+    prev = set_store(HttpObjectStore(
+        timeout=10, retry=RetryPolicy(max_attempts=1)))
+    try:
+        url = f"{base}/bucket/pub"
+        cfg = _cfg(str(tmp_path / "stream"), str(tmp_path / "ckpt"), url)
+        state = create_train_state(cfg)
+        plan.set_rules([{"verb": "PUT", "key": "bucket/pub/MANIFEST-*",
+                         "times": 2, "status": 503}])
+        pub = ModelPublisher(
+            url,
+            retry=RetryPolicy(max_attempts=4, base_delay_secs=0.01,
+                              max_delay_secs=0.05, rng=random.Random(0)),
+        )
+        manifest = pub.publish(cfg, state)
+        assert manifest.version == 1
+        assert plan.fired_total == 2  # both scripted failures were consumed
+        assert list_versions(url) == [1]
+        # the committed artifact is whole: hash matches the state published
+        assert read_manifest(url, 1).param_hash == param_tree_hash(
+            state.params, state.model_state
+        )
+    finally:
+        set_store(prev)
+
+
+# ------------------------------------------------------------- stream
+
+
+def test_stream_reader_quarantines_poisoned_segment(chaos_store):
+    """A segment that keeps failing after store retries is skipped with a
+    metric after max_segment_failures polls; earlier and later segments
+    flow — the tailer never wedges."""
+    plan, base, _ = chaos_store
+    url = f"{base}/bucket/events"
+    _fill_stream(url, segments=3, rows=8)
+    bad = segment_name(1)
+    plan.set_rules([{"verb": "GET", "key": f"bucket/events/{bad}",
+                     "times": -1, "status": 500}])
+    reader = EventLogReader(
+        PrefixTail(url), field_size=FIELD, batch_size=8,
+        poll_interval_secs=0.02, max_segment_failures=3,
+    )
+    items = list(reader.batches(follow=True, max_batches=2,
+                                idle_timeout_secs=10))
+    assert len(items) == 2
+    # segment 0 then segment 2 — the poisoned middle one was skipped
+    assert items[0][1] == type(items[0][1])(segment=segment_name(0), record=8)
+    assert items[1][1].segment == segment_name(2)
+    stats = reader.stats()
+    assert stats["quarantined"] == [bad]
+    assert stats["read_failures_total"] >= 3
+
+
+def test_stream_reader_oneshot_read_errors_stay_loud(chaos_store):
+    """follow=False is the batch/oracle path: silent truncation would be
+    data loss, so exhausted-retry reads raise."""
+    plan, base, _ = chaos_store
+    url = f"{base}/bucket/events_loud"
+    _fill_stream(url, segments=2, rows=8)
+    plan.set_rules([{"verb": "GET",
+                     "key": f"bucket/events_loud/{segment_name(1)}",
+                     "times": -1, "status": 500}])
+    reader = EventLogReader(PrefixTail(url), field_size=FIELD, batch_size=8)
+    with pytest.raises(ObjectStoreError):
+        list(reader.batches(follow=False))
+
+
+def test_stream_tailer_survives_list_outage(chaos_store):
+    """A whole-store LIST outage mid-tail: the follow loop logs, re-polls,
+    and resumes when the store comes back."""
+    plan, base, _ = chaos_store
+    url = f"{base}/bucket/events_outage"
+    _fill_stream(url, segments=1, rows=8)
+    reader = EventLogReader(
+        PrefixTail(url), field_size=FIELD, batch_size=8,
+        poll_interval_secs=0.02,
+    )
+    got = []
+    stop = threading.Event()
+
+    def consume():
+        for item in reader.batches(follow=True, stop=stop,
+                                   idle_timeout_secs=30):
+            got.append(item)
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    deadline = time.time() + 20
+    while not got and time.time() < deadline:
+        time.sleep(0.01)
+    assert len(got) == 1
+    # outage: every LIST fails (store retries exhausted each poll)
+    plan.set_rules([{"verb": "LIST", "key": "bucket/events_outage*",
+                     "times": -1, "status": 503}])
+    time.sleep(0.3)
+    _fill_stream(url, segments=2, rows=8)  # lands during the outage
+    plan.clear()  # store recovers
+    while len(got) < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    assert len(got) == 2, "tailer never recovered from the LIST outage"
+    stop.set()
+    t.join(timeout=10)
+    assert not t.is_alive()
+
+
+def test_stream_truncated_segment_reads_heal_via_resume(chaos_store):
+    """Mid-body truncation on segment GETs is healed by the resuming
+    stream — batches decode whole, nothing quarantined."""
+    plan, base, _ = chaos_store
+    url = f"{base}/bucket/events_trunc"
+    _fill_stream(url, segments=2, rows=32)
+    plan.set_rules([{"verb": "GET", "key": "bucket/events_trunc/*",
+                     "times": 3, "truncate": 0.4}])
+    reader = EventLogReader(PrefixTail(url), field_size=FIELD, batch_size=32)
+    items = list(reader.batches(follow=False))
+    assert [it[0]["label"].shape[0] for it in items] == [32, 32]
+    assert reader.stats()["segments_quarantined"] == 0
+    assert plan.fired_total == 3
+
+
+# ------------------------------------------------------------- hot swapper
+
+
+def _swappable(tmp_path, cfg):
+    from deepfm_tpu.serve.export import export_servable
+    from deepfm_tpu.serve.reload import load_swappable_servable
+    from deepfm_tpu.train import create_train_state
+
+    servable = str(tmp_path / "servable_v0")
+    export_servable(cfg, create_train_state(cfg), servable)
+    return load_swappable_servable(servable)
+
+
+def test_hot_swapper_breaker_opens_on_outage_and_recovers(chaos_store, tmp_path):
+    """Store outage while polling: poll errors trip the breaker, further
+    polls are SKIPPED (no retry storm) while old weights keep serving;
+    after the cooldown one probe closes the circuit and the published
+    version swaps in."""
+    from deepfm_tpu.serve.reload import HotSwapper
+    from deepfm_tpu.train import create_train_state
+    from deepfm_tpu.utils.retry import CircuitBreaker
+
+    plan, base, _ = chaos_store
+    url = f"{base}/bucket/publish"
+    cfg = _cfg(str(tmp_path / "stream"), str(tmp_path / "ckpt"), url)
+    predict, predict_with, holder, scfg = _swappable(tmp_path, cfg)
+    breaker = CircuitBreaker(failure_threshold=0.5, window=6, min_calls=3,
+                             cooldown_secs=0.3, name="reload")
+    swapper = HotSwapper(
+        holder, predict_with, url, scfg,
+        staging_dir=str(tmp_path / "staging"), breaker=breaker,
+    )
+
+    # outage: every LIST against the publish root fails
+    plan.set_rules([{"verb": "LIST", "key": "bucket/publish*",
+                     "times": -1, "status": 503}])
+    for _ in range(3):
+        assert swapper.poll_once() is False
+    st = swapper.status()
+    assert st["poll_errors_total"] == 3
+    assert st["breaker"]["state"] == "open"
+    assert st["rollbacks_total"] == 0  # outage must not read as bad weights
+
+    # open circuit: polls are skipped, the store gets a rest
+    assert swapper.poll_once() is False
+    assert swapper.status()["polls_skipped_total"] == 1
+    assert swapper.status()["poll_errors_total"] == 3  # unchanged
+
+    # old weights keep serving through the whole outage
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, FEATURE, (4, FIELD)).astype(np.int64)
+    vals = rng.random((4, FIELD)).astype(np.float32)
+    assert np.isfinite(np.asarray(predict(ids, vals))).all()
+    assert holder.version == 0
+
+    # store recovers; a version is waiting; cooldown elapses -> probe swaps
+    plan.clear()
+    pub = ModelPublisher(url)
+    pub.publish(cfg, create_train_state(cfg))
+    time.sleep(0.35)
+    assert swapper.poll_once() is True
+    assert holder.version == 1
+    st = swapper.status()
+    assert st["breaker"]["state"] == "closed"
+    assert st["swaps_total"] == 1
+
+
+def test_hot_swapper_fetch_outage_is_poll_error_not_rollback(chaos_store, tmp_path):
+    """Discovery works but the artifact fetch 500s: that is breaker food
+    (poll error), not a rollback — nothing was ever canaried."""
+    from deepfm_tpu.serve.reload import HotSwapper
+    from deepfm_tpu.train import create_train_state
+
+    plan, base, _ = chaos_store
+    url = f"{base}/bucket/publish2"
+    cfg = _cfg(str(tmp_path / "stream"), str(tmp_path / "ckpt"), url)
+    ModelPublisher(url).publish(cfg, create_train_state(cfg))
+    predict, predict_with, holder, scfg = _swappable(tmp_path, cfg)
+    swapper = HotSwapper(
+        holder, predict_with, url, scfg,
+        staging_dir=str(tmp_path / "staging"),
+    )
+    plan.set_rules([{"verb": "GET", "key": "bucket/publish2/versions/*",
+                     "times": -1, "status": 500}])
+    assert swapper.poll_once() is False
+    st = swapper.status()
+    assert st["poll_errors_total"] == 1
+    assert st["rollbacks_total"] == 0
+    assert "stage:" in st["last_error"]
+    # faults gone -> next poll stages and swaps
+    plan.clear()
+    assert swapper.poll_once() is True
+    assert holder.version == 1
+
+
+def test_hot_swapper_survives_truncated_artifact_download(chaos_store, tmp_path):
+    """Mid-body truncation while staging a version: the resuming stream
+    re-fetches from the cut offset, the param hash verifies, the swap
+    lands — truncation costs a reconnect, never a torn model."""
+    from deepfm_tpu.serve.reload import HotSwapper
+    from deepfm_tpu.train import create_train_state
+
+    plan, base, _ = chaos_store
+    url = f"{base}/bucket/publish3"
+    cfg = _cfg(str(tmp_path / "stream"), str(tmp_path / "ckpt"), url)
+    ModelPublisher(url).publish(cfg, create_train_state(cfg))
+    predict, predict_with, holder, scfg = _swappable(tmp_path, cfg)
+    swapper = HotSwapper(
+        holder, predict_with, url, scfg,
+        staging_dir=str(tmp_path / "staging"),
+    )
+    plan.set_rules([{"verb": "GET", "key": "bucket/publish3/versions/*",
+                     "times": 4, "truncate": 0.5}])
+    assert swapper.poll_once() is True
+    assert holder.version == 1
+    assert swapper.status()["rollbacks_total"] == 0
+    assert plan.fired_total == 4
+
+
+# --------------------------------------------------------------- e2e (slow)
+
+
+def _post_predict(base, instances, timeout=30):
+    req = urllib.request.Request(
+        f"{base}:predict",
+        data=json.dumps({"instances": instances}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.load(r)
+
+
+@pytest.mark.slow
+def test_e2e_serve_zero_failed_predicts_through_store_chaos(
+        chaos_store, tmp_path, monkeypatch):
+    """Acceptance drill, serve half: a live HTTP engine with hot reload
+    pointed at an object-store publish root; scripted faults (publish-PUT
+    500s, a poll outage that opens the breaker, mid-body truncation while
+    staging) — concurrent predict clients NEVER see a failure, /readyz
+    flips 503 while the breaker is open and recovers, and the new version
+    swaps in once the store heals."""
+    import deepfm_tpu.serve.reload as reload_mod
+    from deepfm_tpu.serve.export import export_servable
+    from deepfm_tpu.serve.server import serve_forever
+    from deepfm_tpu.train import create_train_state
+
+    plan, base_url, _ = chaos_store
+    publish = f"{base_url}/bucket/publish_e2e"
+    stream = str(tmp_path / "stream")
+    cfg = _cfg(stream, str(tmp_path / "ckpt"), publish,
+               online_publish_every_steps=0)
+
+    # shrink the default breaker cooldown so the recovery leg of the drill
+    # runs in test time (the breaker itself is the production default)
+    orig_breaker = reload_mod.CircuitBreaker
+
+    def quick_breaker(**kw):
+        kw["cooldown_secs"] = 0.6
+        return orig_breaker(**kw)
+
+    monkeypatch.setattr(reload_mod, "CircuitBreaker", quick_breaker)
+
+    servable = str(tmp_path / "servable_v0")
+    export_servable(cfg, create_train_state(cfg), servable)
+    ready = threading.Event()
+    t = threading.Thread(
+        target=serve_forever,
+        args=(servable,),
+        kwargs=dict(port=0, model_name="deepfm", buckets=(4, 8),
+                    max_wait_ms=1.0, reload_url=publish,
+                    reload_interval_secs=0.05, ready=ready),
+        daemon=True,
+    )
+    t.start()
+    assert ready.wait(timeout=120), "server did not come up"
+    host = f"http://127.0.0.1:{ready.port}"
+    model_base = f"{host}/v1/models/deepfm"
+
+    # concurrent clients hammer :predict across the whole chaos window
+    stop = threading.Event()
+    failures: list[str] = []
+    counts = [0]
+    lock = threading.Lock()
+
+    def client(seed):
+        crng = np.random.default_rng(seed)
+        inst = [
+            {"feat_ids": crng.integers(0, FEATURE, FIELD).tolist(),
+             "feat_vals": crng.random(FIELD).round(4).tolist()}
+            for _ in range(2)
+        ]
+        while not stop.is_set():
+            try:
+                doc = _post_predict(model_base, inst, timeout=30)
+                assert len(doc["predictions"]) == 2
+                with lock:
+                    counts[0] += 1
+            except Exception as e:
+                failures.append(f"{type(e).__name__}: {e}")
+                return
+
+    clients = [threading.Thread(target=client, args=(100 + i,), daemon=True)
+               for i in range(4)]
+    for c in clients:
+        c.start()
+
+    def metrics():
+        with urllib.request.urlopen(f"{host}/v1/metrics", timeout=30) as r:
+            return json.load(r)
+
+    def readyz():
+        try:
+            with urllib.request.urlopen(f"{host}/readyz", timeout=30) as r:
+                return r.status, json.load(r)
+        except urllib.error.HTTPError as e:
+            return e.code, json.load(e)
+
+    with urllib.request.urlopen(f"{host}/healthz", timeout=30) as r:
+        assert r.status == 200
+    assert readyz()[0] == 200
+
+    # -- phase 1: poll outage opens the breaker; serving keeps going -------
+    plan.set_rules([{"verb": "LIST", "key": "bucket/publish_e2e*",
+                     "times": -1, "status": 503}])
+    deadline = time.time() + 30
+    state = None
+    while time.time() < deadline:
+        state = metrics()["reload"]["breaker"]["state"]
+        if state == "open":
+            break
+        time.sleep(0.05)
+    assert state == "open", f"breaker never opened (last state {state})"
+    code, doc = readyz()
+    assert code == 503 and doc["ready"] is False
+    assert doc["reload_breaker"] == "open"
+    skipped_before = metrics()["reload"]["polls_skipped_total"]
+    time.sleep(0.3)
+    assert metrics()["reload"]["polls_skipped_total"] >= skipped_before
+
+    # -- phase 2: store heals; publish v1 under PUT 500s + truncation ------
+    plan.set_rules([
+        {"verb": "PUT", "key": "bucket/publish_e2e/*", "times": 3,
+         "status": 500},
+        {"verb": "GET", "key": "bucket/publish_e2e/versions/*", "times": 2,
+         "truncate": 0.5},
+    ])
+    _fill_stream(stream, segments=2, rows=8)
+    OnlineTrainer(cfg).run(follow=False)  # publishes through the PUT faults
+    assert latest_manifest(publish).version == 1
+
+    deadline = time.time() + 60
+    version = 0
+    while time.time() < deadline:
+        snap = metrics()["reload"]
+        version = snap["model_version"]
+        if version >= 1:
+            break
+        time.sleep(0.05)
+    assert version == 1, f"swap never happened after recovery: {snap}"
+    assert snap["rollbacks_total"] == 0
+    assert snap["breaker"]["state"] == "closed"
+    code, doc = readyz()
+    assert code == 200 and doc["model_version"] == 1
+
+    time.sleep(0.2)
+    stop.set()
+    for c in clients:
+        c.join(timeout=30)
+    assert not failures, f"predicts failed during chaos: {failures[:3]}"
+    assert counts[0] > 0
+
+
+@pytest.mark.slow
+def test_e2e_trainer_crash_resume_bit_exact_under_upload_faults(
+        chaos_store, tmp_path):
+    """Acceptance drill, train half: online trainer checkpointing to a
+    REMOTE model_dir; checkpoint uploads eat injected 500s (absorbed by
+    retry), the trainer crashes after a commit, the local staging cache is
+    wiped (new-host restart), and the resume — which must download the
+    committed step through injected mid-body truncation — lands bit-exact
+    with an uninterrupted replay."""
+    from deepfm_tpu.checkpoint.remote import _staging_dir_for
+
+    plan, base_url, store = chaos_store
+    ckpt_url = f"{base_url}/bucket/ckpt_e2e"
+    publish = f"{base_url}/bucket/publish_train_e2e"
+    stream = str(tmp_path / "stream")
+    cfg = _cfg(stream, ckpt_url, publish)
+    _fill_stream(stream, segments=6, rows=8)
+    staging = _staging_dir_for(ckpt_url)
+    shutil.rmtree(staging, ignore_errors=True)  # pristine first boot
+
+    # checkpoint uploads hit transient 500s (fewer than the retry budget)
+    plan.set_rules([{"verb": "PUT", "key": "bucket/ckpt_e2e/*", "times": 3,
+                     "status": 500}])
+
+    class Crash(RuntimeError):
+        pass
+
+    commits = []
+
+    def crash_after_first_commit(state, cursor):
+        commits.append(int(state.step))
+        raise Crash("killed after commit")
+
+    with pytest.raises(Crash):
+        OnlineTrainer(cfg).run(follow=False,
+                               on_commit=crash_after_first_commit)
+    assert commits == [2]
+    assert plan.fired_total == 3  # the scripted PUT faults were consumed
+    # the commit IS durable remotely despite the faults
+    names = [u.rsplit("/", 1)[-1]
+             for u in store.list_prefix(ckpt_url + "/")]
+    assert "_COMMIT_2" in names
+
+    # "new host": wipe the staging cache so resume must download the step;
+    # the download eats mid-body truncation (healed by ranged resume)
+    shutil.rmtree(staging, ignore_errors=True)
+    plan.set_rules([{"verb": "GET", "key": "bucket/ckpt_e2e/2/*",
+                     "times": 3, "truncate": 0.5}])
+    fired_before = plan.fired_total
+    state = OnlineTrainer(cfg).run(follow=False)
+    assert int(state.step) == 6
+    assert plan.fired_total == fired_before + 3
+
+    # bit-exact with the uninterrupted oracle == nothing double-applied,
+    # nothing lost, despite every injected storage fault
+    ref = replay_to_state(cfg)
+    for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(ref.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    manifest = latest_manifest(publish)
+    assert manifest.step == 6
+    assert manifest.param_hash == param_tree_hash(
+        state.params, state.model_state
+    )
+    shutil.rmtree(staging, ignore_errors=True)
+
+
+def test_readyz_and_healthz_without_reload(tmp_path):
+    """The probes exist (and are ready) on a plain static-weights server."""
+    from deepfm_tpu.serve.export import export_servable
+    from deepfm_tpu.serve.server import serve_forever
+    from deepfm_tpu.train import create_train_state
+
+    cfg = _cfg(str(tmp_path / "s"), str(tmp_path / "c"),
+               str(tmp_path / "p"))
+    servable = str(tmp_path / "servable")
+    export_servable(cfg, create_train_state(cfg), servable)
+    ready = threading.Event()
+    t = threading.Thread(
+        target=serve_forever, args=(servable,),
+        kwargs=dict(port=0, buckets=(4,), ready=ready), daemon=True,
+    )
+    t.start()
+    assert ready.wait(timeout=120)
+    host = f"http://127.0.0.1:{ready.port}"
+    with urllib.request.urlopen(f"{host}/healthz", timeout=30) as r:
+        assert json.load(r)["status"] == "alive"
+    with urllib.request.urlopen(f"{host}/readyz", timeout=30) as r:
+        doc = json.load(r)
+    assert doc["ready"] is True and doc["engine_compiled"] is True

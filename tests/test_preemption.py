@@ -188,6 +188,41 @@ def test_run_with_restarts_exhausts():
         run_with_restarts(always_fails, max_restarts=2, backoff_secs=0.01)
 
 
+def test_run_with_restarts_backoff_is_exponential_jittered_capped():
+    """Crash-loop backoff: doubles per consecutive crash, jittered within
+    [cap/2, cap] (lockstep fleet restarts would hammer shared storage),
+    capped at max_backoff_secs.  Injected sleep — no real waits."""
+    import random
+
+    sleeps = []
+    calls = {"n": 0}
+
+    def always_fails():
+        calls["n"] += 1
+        raise RuntimeError("crash")
+
+    with pytest.raises(RuntimeError):
+        run_with_restarts(
+            always_fails, max_restarts=5, backoff_secs=1.0,
+            max_backoff_secs=4.0, sleep=sleeps.append,
+            rng=random.Random(7),
+        )
+    assert calls["n"] == 6 and len(sleeps) == 5
+    caps = [1.0, 2.0, 4.0, 4.0, 4.0]  # doubling, then capped
+    for got, cap in zip(sleeps, caps):
+        assert cap / 2.0 <= got <= cap
+    # jitter actually jitters: two different seeds disagree
+    sleeps2 = []
+    calls["n"] = 0
+    with pytest.raises(RuntimeError):
+        run_with_restarts(
+            always_fails, max_restarts=5, backoff_secs=1.0,
+            max_backoff_secs=4.0, sleep=sleeps2.append,
+            rng=random.Random(8),
+        )
+    assert sleeps != sleeps2
+
+
 def test_run_with_restarts_preempted_not_retried():
     calls = {"n": 0}
 

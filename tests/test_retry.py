@@ -1,0 +1,315 @@
+"""Retry/circuit-breaker policy (utils/retry.py) and the hardened object
+store's error classification: 500/503/429 and connection drops retry,
+other 4xx fail fast, all on injectable fake clocks — no real sleeps."""
+
+import random
+import threading
+
+import pytest
+
+from deepfm_tpu.data.object_store import HttpObjectStore, ObjectStoreError
+from deepfm_tpu.utils.dev_object_store import serve
+from deepfm_tpu.utils.retry import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryPolicy,
+)
+
+
+class FakeClock:
+    """Deterministic clock: ``sleep`` advances it, nothing really waits."""
+
+    def __init__(self):
+        self.now = 0.0
+        self.sleeps: list[float] = []
+
+    def __call__(self) -> float:
+        return self.now
+
+    def sleep(self, secs: float) -> None:
+        self.sleeps.append(secs)
+        self.now += secs
+
+    def advance(self, secs: float) -> None:
+        self.now += secs
+
+
+def _policy(clock, **kw):
+    kw.setdefault("rng", random.Random(0))
+    return RetryPolicy(clock=clock, sleep=clock.sleep, **kw)
+
+
+# ------------------------------------------------------------ RetryPolicy
+
+
+def test_retry_policy_retries_then_succeeds():
+    clock = FakeClock()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    policy = _policy(clock, max_attempts=4, base_delay_secs=0.1)
+    assert policy.call(flaky) == "ok"
+    assert calls["n"] == 3
+    assert len(clock.sleeps) == 2
+    # full jitter: each delay within [0, base * 2^(attempt-1)]
+    assert 0.0 <= clock.sleeps[0] <= 0.1
+    assert 0.0 <= clock.sleeps[1] <= 0.2
+
+
+def test_retry_policy_exhausts_attempts():
+    clock = FakeClock()
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise OSError("down")
+
+    policy = _policy(clock, max_attempts=3, base_delay_secs=0.1)
+    with pytest.raises(OSError, match="down"):
+        policy.call(always)
+    assert calls["n"] == 3
+
+
+def test_retry_policy_nonretryable_fails_fast():
+    clock = FakeClock()
+    calls = {"n": 0}
+
+    def denied():
+        calls["n"] += 1
+        raise ObjectStoreError("GET x -> HTTP 403 Forbidden",
+                               status=403, retryable=False)
+
+    policy = _policy(clock, max_attempts=5)
+    with pytest.raises(ObjectStoreError):
+        policy.call(denied)
+    assert calls["n"] == 1 and clock.sleeps == []
+
+
+def test_retry_policy_backoff_caps_and_deadline():
+    clock = FakeClock()
+    policy = _policy(clock, max_attempts=10, base_delay_secs=1.0,
+                     max_delay_secs=4.0)
+    assert policy.backoff_cap(1) == 1.0
+    assert policy.backoff_cap(3) == 4.0  # capped, not 4.0 < 2^2... == 4
+    assert policy.backoff_cap(8) == 4.0
+
+    # deadline: stop retrying once the projected wait would overrun it
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        clock.advance(1.0)  # each attempt costs 1s of fake time
+        raise OSError("down")
+
+    tight = _policy(clock, max_attempts=100, base_delay_secs=1.0,
+                    max_delay_secs=1.0, deadline_secs=3.0)
+    with pytest.raises(OSError):
+        tight.call(always)
+    assert calls["n"] < 10  # nowhere near max_attempts: the deadline cut it
+
+
+def test_retry_policy_on_retry_hook():
+    clock = FakeClock()
+    seen = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("once")
+        return 1
+
+    _policy(clock).call(flaky, on_retry=lambda a, e, d: seen.append((a, d)))
+    assert len(seen) == 1 and seen[0][0] == 1
+
+
+# ---------------------------------------------------------- CircuitBreaker
+
+
+def _breaker(clock, **kw):
+    kw.setdefault("failure_threshold", 0.5)
+    kw.setdefault("window", 4)
+    kw.setdefault("min_calls", 2)
+    kw.setdefault("cooldown_secs", 10.0)
+    return CircuitBreaker(clock=clock, **kw)
+
+
+def test_breaker_opens_on_failure_rate_and_cools_down():
+    clock = FakeClock()
+    br = _breaker(clock)
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    assert br.state == "closed"  # below min_calls
+    br.record_failure()
+    assert br.state == "open"  # 2/2 failures >= 50%
+    assert not br.allow()
+    assert br.open_total == 1
+
+    clock.advance(9.0)
+    assert not br.allow()  # still cooling down
+    clock.advance(2.0)
+    assert br.state == "half_open"
+    assert br.allow()  # one probe admitted
+    assert not br.allow()  # ...and only one
+    br.record_success()
+    assert br.state == "closed"
+    assert br.allow()
+
+
+def test_breaker_probe_failure_reopens():
+    clock = FakeClock()
+    br = _breaker(clock)
+    br.record_failure()
+    br.record_failure()
+    clock.advance(11.0)
+    assert br.allow()  # half-open probe
+    br.record_failure()
+    assert br.state == "open" and br.open_total == 2
+    assert not br.allow()
+    assert br.cooldown_remaining() == pytest.approx(10.0)
+
+
+def test_breaker_successes_keep_it_closed():
+    clock = FakeClock()
+    br = _breaker(clock, window=4)
+    for _ in range(10):
+        br.record_success()
+    br.record_failure()
+    # 1 failure out of the 4-call window: 25% < 50% threshold
+    assert br.state == "closed"
+
+
+def test_breaker_call_wrapper():
+    clock = FakeClock()
+    br = _breaker(clock, min_calls=1)
+    with pytest.raises(OSError):
+        br.call(lambda: (_ for _ in ()).throw(OSError("x")))
+    assert br.state == "open"
+    with pytest.raises(CircuitOpenError):
+        br.call(lambda: "unreachable")
+    clock.advance(11.0)
+    assert br.call(lambda: "ok") == "ok"
+    assert br.state == "closed"
+
+
+def test_breaker_thread_safety_smoke():
+    br = CircuitBreaker(failure_threshold=0.9, window=64, min_calls=64,
+                        cooldown_secs=0.01)
+
+    def hammer():
+        for i in range(200):
+            if br.allow():
+                (br.record_success if i % 2 else br.record_failure)()
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert br.state in ("closed", "open", "half_open")
+
+
+# ---------------------------------- store classification (dev-store faults)
+
+
+@pytest.fixture()
+def faulty_store(tmp_path):
+    root = tmp_path / "store_root"
+    (root / "bucket").mkdir(parents=True)
+    server, base = serve(str(root))
+    clock = FakeClock()
+    store = HttpObjectStore(
+        timeout=10,
+        retry=RetryPolicy(max_attempts=4, base_delay_secs=0.01,
+                          sleep=lambda s: None, rng=random.Random(0)),
+    )
+    yield server.fault_plan, base, store
+    server.shutdown()
+    server.server_close()
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("status", [500, 503, 429])
+def test_transient_statuses_retry(faulty_store, status):
+    plan, base, store = faulty_store
+    url = f"{base}/bucket/k"
+    store.put(url, b"payload")
+    plan.set_rules([{"verb": "GET", "key": "bucket/k",
+                     "times": 2, "status": status}])
+    assert store.get(url) == b"payload"  # survived 2 injected failures
+    assert plan.fired_total == 2
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("status", [403, 404])
+def test_client_errors_fail_fast(faulty_store, status):
+    plan, base, store = faulty_store
+    url = f"{base}/bucket/k2"
+    store.put(url, b"payload")
+    plan.set_rules([{"verb": "GET", "key": "bucket/k2",
+                     "times": -1, "status": status}])
+    with pytest.raises(ObjectStoreError) as ei:
+        store.get(url)
+    assert ei.value.status == status and ei.value.retryable is False
+    # exactly one attempt: the rule fired once, never again
+    assert plan.fired_total == 1
+
+
+@pytest.mark.chaos
+def test_connection_drop_retries(faulty_store):
+    plan, base, store = faulty_store
+    url = f"{base}/bucket/k3"
+    store.put(url, b"payload")
+    plan.set_rules([{"verb": "GET", "key": "bucket/k3",
+                     "times": 2, "drop": True}])
+    assert store.get(url) == b"payload"
+
+
+@pytest.mark.chaos
+def test_put_retries_and_converges(faulty_store):
+    plan, base, store = faulty_store
+    url = f"{base}/bucket/k4"
+    plan.set_rules([{"verb": "PUT", "key": "bucket/k4",
+                     "times": 2, "status": 503}])
+    store.put(url, b"v1")  # blind re-PUT is safe: full-object semantics
+    assert store.get(url) == b"v1"
+
+
+@pytest.mark.chaos
+def test_exists_still_distinguishes_missing(faulty_store):
+    plan, base, store = faulty_store
+    assert store.exists(f"{base}/bucket/nope") is False
+    url = f"{base}/bucket/k5"
+    store.put(url, b"x")
+    plan.set_rules([{"verb": "HEAD", "key": "bucket/k5",
+                     "times": 1, "status": 500}])
+    assert store.exists(url) is True
+
+
+@pytest.mark.chaos
+def test_resume_budget_resets_on_progress(faulty_store):
+    """Every first GET attempt of the object truncates mid-body; the
+    resuming stream keeps making progress, so far more truncations than
+    max_resumes are survivable (the budget bounds consecutive stalls)."""
+    plan, base, store = faulty_store
+    url = f"{base}/bucket/big"
+    payload = bytes(range(256)) * 1024  # 256 KiB
+    store.put(url, payload)
+    # every GET serves ~30% of the remaining body then cuts the connection:
+    # needs ~15 resumes to finish — 3x the per-gap budget of 5
+    plan.set_rules([{"verb": "GET", "key": "bucket/big",
+                     "times": 15, "truncate": 0.3}])
+    got = bytearray()
+    with store.open_read_resuming(url, max_resumes=5) as r:
+        while True:
+            chunk = r.read(1 << 15)
+            if not chunk:
+                break
+            got.extend(chunk)
+    assert bytes(got) == payload
+    assert plan.fired_total > 5
