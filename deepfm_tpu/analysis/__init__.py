@@ -1,0 +1,39 @@
+"""JAX-aware static analysis suite (``python -m deepfm_tpu.analysis``).
+
+Two engines over the package (docs/ARCHITECTURE.md "Static analysis &
+correctness gates"):
+
+* **engine 1** (`ast_rules`, `guarded_by`) — a parse-only AST pass with
+  rules pyflakes cannot express: tracer-host-op, traced-nondeterminism,
+  prng-reuse, int32-cast, swallowed-exception, and the guarded-by race
+  lint for the threaded serve/online modules;
+* **engine 2** (`trace_audit`) — imports the real entrypoints and checks
+  lowering-level contracts without executing a step: no implicit
+  transfers under ``jax.transfer_guard("disallow")``, bucket-shape →
+  executable coverage (no silent recompiles), hot-swap-is-a-cache-hit,
+  train-step donation, and dtype promotion.
+
+Findings carry file:line, rule id, fix hint, and a stable fingerprint;
+``analysis_baseline.json`` ratchets accepted debt (baseline.py) and
+``# da:allow[rule] reason`` suppresses inline (findings.py).
+"""
+
+from .ast_rules import analyze_modules
+from .baseline import load_baseline, partition, write_baseline
+from .cli import main, run_ast_engine
+from .findings import RULES, Finding, apply_suppressions, fingerprint_findings
+from .guarded_by import check_guarded_by
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "analyze_modules",
+    "apply_suppressions",
+    "check_guarded_by",
+    "fingerprint_findings",
+    "load_baseline",
+    "main",
+    "partition",
+    "run_ast_engine",
+    "write_baseline",
+]

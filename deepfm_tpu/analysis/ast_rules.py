@@ -1,0 +1,687 @@
+"""Engine 1 — AST rules ruff's pyflakes ruleset cannot express.
+
+The pass is JAX-aware: it first infers which functions are *jit-reachable*
+(traced), then applies tracer-safety rules only inside those, so host-side
+code keeps its idioms (``float()`` on a config value is fine; ``float()``
+on a traced activation is a device sync or a ConcretizationTypeError).
+
+Jit-reachability (two project-wide passes):
+
+1. **collect** — per module: every function def; names *decorated* with a
+   tracing transform (``@jax.jit``, ``@partial(jax.jit, ...)``,
+   ``@jax.custom_vjp`` ...); names *passed to* a transform call
+   (``jax.jit(f)``, ``jax.grad(f)``, ``jax.lax.scan(f, ...)``); and
+   *factories* — functions whose RESULT is transformed
+   (``jax.jit(make_train_step(cfg))`` marks ``make_train_step``).  Entry
+   and factory name sets are unioned across modules, so the online
+   trainer jitting ``make_train_step`` (imported from ``train.step``)
+   marks the factory in its home module.
+2. **propagate** — a factory's returned inner defs are traced (a factory
+   returning another module function's call marks that function a factory
+   too, to a fixpoint); nested defs inside traced functions are traced;
+   bare-name calls from traced functions mark the callee (same-module
+   BFS).
+
+Rules (ids in findings.RULES): tracer-host-op, traced-nondeterminism,
+prng-reuse, int32-cast, swallowed-exception.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .findings import Finding
+
+# transforms whose function argument is traced
+_TRANSFORMS = {
+    "jit", "pjit", "grad", "value_and_grad", "vmap", "pmap", "eval_shape",
+    "checkpoint", "remat", "custom_vjp", "custom_jvp", "shard_map",
+    "named_call", "linear_transpose", "hessian", "jacfwd", "jacrev",
+    # jax.lax control flow: the callable operands are traced
+    "map", "scan", "cond", "while_loop", "switch", "fori_loop",
+    "associative_scan",
+}
+
+# numpy attribute CALLS that are fine inside a trace (metadata over dtypes,
+# not ops over values)
+_NP_SAFE_CALLS = {
+    "dtype", "iinfo", "finfo", "result_type", "promote_types",
+    "broadcast_shapes", "ndim", "issubdtype",
+}
+
+_HOST_METHODS = {"item", "tolist", "numpy", "to_py"}
+
+_NONDET_MODULES = {"random"}          # python stdlib random.*
+_NONDET_TIME_FNS = {"time", "time_ns", "perf_counter", "perf_counter_ns",
+                    "monotonic", "monotonic_ns", "process_time"}
+_NONDET_DATETIME_FNS = {"now", "utcnow", "today"}
+
+_INT32_NAMES = {"int32"}
+_ACCUM_CALLS = {"sum", "cumsum", "prod", "cumprod", "dot", "matmul",
+                "einsum", "tensordot", "vdot"}
+_MUTATING_BINOPS = (ast.Add, ast.Mult, ast.Pow, ast.LShift)
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.lax.scan' for nested attributes, '' when not a plain path."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+# control-flow names that exist on plenty of non-jax objects
+# (executor.map, re.match(...).group... ) — only trust them under a lax/jax
+# receiver; the distinctive transform names are trusted on any receiver
+_AMBIGUOUS = {"map", "scan", "cond", "switch", "while_loop", "fori_loop",
+              "associative_scan", "checkpoint"}
+
+
+def _is_transform(callee: ast.AST) -> bool:
+    d = _dotted(callee)
+    if not d:
+        return False
+    parts = d.split(".")
+    last = parts[-1]
+    if last not in _TRANSFORMS:
+        return False
+    if last in _AMBIGUOUS:
+        # jax.lax.map / lax.map / jax.checkpoint — never executor.map
+        return len(parts) > 1 and parts[-2] in ("lax", "jax")
+    return True
+
+
+def _unwrap_partial(dec: ast.AST) -> ast.AST:
+    """@functools.partial(jax.jit, ...) -> jax.jit."""
+    if isinstance(dec, ast.Call) and _dotted(dec.func).rsplit(".", 1)[-1] == "partial":
+        if dec.args:
+            return dec.args[0]
+    return dec
+
+
+class _ModuleInfo:
+    def __init__(self, path: str, tree: ast.Module):
+        self.path = path
+        self.tree = tree
+        # every def sharing a bare name is kept and analyzed — method-name
+        # collisions (__init__, close, run) are ubiquitous and "first def
+        # wins" would silently skip exactly the bodies being checked
+        self.functions: dict[str, list[ast.AST]] = {}
+        self.top_level: set[str] = set()            # importable (module scope)
+        self.entry_names: set[str] = set()          # traced directly
+        self.factory_names: set[str] = set()        # result is traced
+        self.calls: dict[str, set[str]] = {}        # name -> union of callees
+
+
+def _collect(info: _ModuleInfo) -> None:
+    for node in info.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info.top_level.add(node.name)
+    # function defs anywhere (nested ones handled during propagation)
+    for node in ast.walk(info.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info.functions.setdefault(node.name, []).append(node)
+            callees = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name):
+                    callees.add(sub.func.id)
+            info.calls.setdefault(node.name, set()).update(callees)
+            for dec in node.decorator_list:
+                base = _unwrap_partial(dec)
+                base = base.func if isinstance(base, ast.Call) else base
+                if _is_transform(base):
+                    info.entry_names.add(node.name)
+        if isinstance(node, ast.Call) and _is_transform(node.func):
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    info.entry_names.add(arg.id)
+                elif isinstance(arg, ast.Call) and isinstance(arg.func, ast.Name):
+                    info.factory_names.add(arg.func.id)
+                elif isinstance(arg, ast.Call) and isinstance(arg.func, ast.Attribute):
+                    info.factory_names.add(arg.func.attr)
+
+
+def _returned_names(fn: ast.AST) -> tuple[set[str], set[str]]:
+    """Names and bare-call names this function returns (direct returns plus
+    elements of returned tuples)."""
+    names, called = set(), set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            vals = (node.value.elts
+                    if isinstance(node.value, ast.Tuple) else [node.value])
+            for v in vals:
+                if isinstance(v, ast.Name):
+                    names.add(v.id)
+                elif isinstance(v, ast.Call) and isinstance(v.func, ast.Name):
+                    called.add(v.func.id)
+    return names, called
+
+
+def compute_traced(modules: list[_ModuleInfo]) -> dict[str, set[str]]:
+    """-> {path: set of traced function names in that module}."""
+    global_entries = set().union(*(m.entry_names for m in modules)) if modules else set()
+    global_factories = set().union(*(m.factory_names for m in modules)) if modules else set()
+
+    # factory fixpoint: a factory returning g() makes g a factory
+    changed = True
+    while changed:
+        changed = False
+        for m in modules:
+            for name in list(global_factories):
+                for fn in m.functions.get(name, ()):
+                    _, called = _returned_names(fn)
+                    for c in called:
+                        if c not in global_factories and any(
+                            c in mm.functions for mm in modules
+                        ):
+                            global_factories.add(c)
+                            changed = True
+
+    traced: dict[str, set[str]] = {}
+    for m in modules:
+        # same-module marks hit any def; cross-module marks only hit
+        # top-level (importable) defs — a nested helper sharing a bare name
+        # with some other module's jitted function is a coincidence, not a
+        # trace boundary
+        local = set(m.entry_names) & set(m.functions)
+        local |= m.top_level & global_entries
+        # factories: their returned inner defs are traced
+        for fname in global_factories:
+            if fname not in m.factory_names and fname not in m.top_level:
+                continue
+            for fn in m.functions.get(fname, ()):
+                ret, _ = _returned_names(fn)
+                inner = {
+                    n.name for n in ast.walk(fn)
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and n is not fn
+                }
+                local |= ret & inner
+        # BFS: nested defs of traced fns + bare-name callees
+        frontier = list(local)
+        while frontier:
+            name = frontier.pop()
+            for fn in m.functions.get(name, ()):
+                for sub in ast.walk(fn):
+                    if (isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                            and sub is not fn and sub.name not in local):
+                        local.add(sub.name)
+                        frontier.append(sub.name)
+            for callee in m.calls.get(name, ()):
+                if callee in m.functions and callee not in local:
+                    local.add(callee)
+                    frontier.append(callee)
+        traced[m.path] = local
+    return traced
+
+
+# --------------------------------------------------------------------------
+# per-rule checks
+# --------------------------------------------------------------------------
+
+def _src_line(src_lines: list[str], lineno: int) -> str:
+    return src_lines[lineno - 1] if 0 < lineno <= len(src_lines) else ""
+
+
+def _check_traced_body(
+    path: str, fn: ast.AST, src_lines: list[str], out: list[Finding],
+    jax_random_aliases: set[str] = frozenset(),
+) -> None:
+    """tracer-host-op + traced-nondeterminism inside one traced function
+    (nested defs are visited as their own traced functions — skip them
+    here so findings attribute to the innermost function)."""
+    nested = {
+        n for n in ast.walk(fn)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and n is not fn
+    }
+
+    def walk_skipping(node):
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if child in nested:
+                continue
+            yield from walk_skipping(child)
+
+    for node in walk_skipping(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        line = _src_line(src_lines, node.lineno)
+        # float()/int()/bool() on a non-literal — except the static-shape
+        # idiom (int(x.shape[0]), len(...)): shapes are python ints at
+        # trace time, no tracer is concretized.  The WHOLE argument must
+        # be static — int(jnp.sum(x) / x.shape[0]) still concretizes the
+        # traced sum
+        if isinstance(node.func, ast.Name) and node.func.id in ("float", "int", "bool"):
+            def _static_at_trace(expr: ast.AST) -> bool:
+                if isinstance(expr, ast.Constant):
+                    return True
+                if isinstance(expr, ast.Attribute):
+                    return expr.attr in ("shape", "ndim", "size")
+                if isinstance(expr, ast.Subscript):
+                    return _static_at_trace(expr.value)
+                if isinstance(expr, ast.Call):
+                    return (isinstance(expr.func, ast.Name)
+                            and expr.func.id == "len")
+                if isinstance(expr, ast.BinOp):
+                    return (_static_at_trace(expr.left)
+                            and _static_at_trace(expr.right))
+                if isinstance(expr, ast.UnaryOp):
+                    return _static_at_trace(expr.operand)
+                if isinstance(expr, (ast.Tuple, ast.List)):
+                    return all(_static_at_trace(e) for e in expr.elts)
+                return False
+
+            if (node.args and not isinstance(node.args[0], ast.Constant)
+                    and not _static_at_trace(node.args[0])):
+                out.append(Finding(
+                    rule="tracer-host-op", path=path,
+                    line=node.lineno, col=node.col_offset,
+                    message=(
+                        f"{node.func.id}() on a value inside jit-reachable "
+                        f"'{getattr(fn, 'name', '<fn>')}' concretizes the "
+                        f"tracer (implicit device sync or trace error)"
+                    ),
+                    hint="keep the value traced (jnp ops) or hoist the "
+                         "conversion out of the jitted function",
+                    source=line,
+                ))
+            continue
+        if isinstance(node.func, ast.Attribute):
+            d = _dotted(node.func)
+            root = d.split(".", 1)[0] if d else ""
+            attr = node.func.attr
+            # .item()/.tolist()/.numpy()
+            if attr in _HOST_METHODS and not node.args:
+                out.append(Finding(
+                    rule="tracer-host-op", path=path,
+                    line=node.lineno, col=node.col_offset,
+                    message=(
+                        f".{attr}() inside jit-reachable "
+                        f"'{getattr(fn, 'name', '<fn>')}' forces a device "
+                        f"sync / fails on tracers"
+                    ),
+                    hint="return the traced array and convert at the call "
+                         "site, outside jit",
+                    source=line,
+                ))
+            # np.* value ops (np.random.* falls through to the
+            # nondeterminism branch below — the fix there is a jax key,
+            # not a jnp spelling)
+            elif (root in ("np", "numpy") and attr not in _NP_SAFE_CALLS
+                  and not d.startswith(("np.random.", "numpy.random."))):
+                out.append(Finding(
+                    rule="tracer-host-op", path=path,
+                    line=node.lineno, col=node.col_offset,
+                    message=(
+                        f"numpy call {d}() inside jit-reachable "
+                        f"'{getattr(fn, 'name', '<fn>')}' runs on host "
+                        f"(tracer leak / silent constant-folding)"
+                    ),
+                    hint="use the jnp equivalent",
+                    source=line,
+                ))
+            # wall clock / python RNG
+            elif root == "time" and attr in _NONDET_TIME_FNS:
+                out.append(Finding(
+                    rule="traced-nondeterminism", path=path,
+                    line=node.lineno, col=node.col_offset,
+                    message=(
+                        f"time.{attr}() inside jit-reachable "
+                        f"'{getattr(fn, 'name', '<fn>')}' is evaluated once "
+                        f"at trace time and frozen into the executable"
+                    ),
+                    hint="pass timestamps in as arguments",
+                    source=line,
+                ))
+            elif (root in _NONDET_MODULES
+                  and root not in jax_random_aliases) or (
+                d.startswith("np.random.") or d.startswith("numpy.random.")
+            ):
+                out.append(Finding(
+                    rule="traced-nondeterminism", path=path,
+                    line=node.lineno, col=node.col_offset,
+                    message=(
+                        f"{d}() inside jit-reachable "
+                        f"'{getattr(fn, 'name', '<fn>')}' draws at trace "
+                        f"time — the 'random' value is a compiled constant"
+                    ),
+                    hint="use jax.random with an explicit key",
+                    source=line,
+                ))
+            elif root == "datetime" and attr in _NONDET_DATETIME_FNS:
+                out.append(Finding(
+                    rule="traced-nondeterminism", path=path,
+                    line=node.lineno, col=node.col_offset,
+                    message=f"datetime.{attr}() inside jit-reachable code",
+                    hint="pass timestamps in as arguments",
+                    source=line,
+                ))
+
+
+def _jax_random_aliases(tree: ast.Module) -> set[str]:
+    """Module-level names that ARE jax.random: ``import jax.random as X``,
+    ``from jax import random [as X]``.  Stdlib ``import random`` is NOT in
+    the set — ``random.uniform(lo, hi)`` must never read as a key draw."""
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.random" and a.asname:
+                    aliases.add(a.asname)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "random":
+                        aliases.add(a.asname or "random")
+            elif node.module == "jax.random":
+                # from jax.random import split, normal: bare-name draws
+                # are rare in this codebase; dotted matching covers the rest
+                pass
+    return aliases
+
+
+def _check_prng_reuse(
+    path: str, fn: ast.AST, src_lines: list[str], out: list[Finding],
+    jax_random_aliases: set[str] = frozenset(),
+) -> None:
+    """Same key name consumed by >1 jax.random draw without re-derivation.
+
+    A small statement-order interpreter over the function body: a parameter
+    or an assignment from PRNGKey/split/fold_in (re)arms a name; use as the
+    first argument of a consuming jax.random draw disarms it; a draw from a
+    disarmed name is a finding.  ``if``/``else`` arms fork the arm-state and
+    merge conservatively (armed only if armed on every path), so two
+    mutually exclusive branches each drawing once are NOT reuse."""
+    prefixes = ["jax.random.", "jrandom."] + [
+        a + "." for a in jax_random_aliases
+    ]
+
+    def random_attr(call: ast.Call) -> str:
+        d = _dotted(call.func)
+        for prefix in prefixes:
+            if d.startswith(prefix):
+                return d[len(prefix):]
+        return ""
+
+    _DERIVE = ("PRNGKey", "key", "split", "fold_in", "clone")
+    _NEUTRAL = _DERIVE + ("wrap_key_data", "key_data")
+    emitted: set[tuple[int, int]] = set()  # dedupe across loop re-passes
+
+    def scan_expr(expr: ast.AST | None, armed: dict[str, bool]) -> None:
+        """Draws inside one expression, in source order; nested defs and
+        lambdas run later — their draws cannot be ordered here, skip."""
+        if expr is None:
+            return
+        draws = []
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call):
+                attr = random_attr(node)
+                if (attr and attr not in _NEUTRAL and node.args
+                        and isinstance(node.args[0], ast.Name)):
+                    draws.append(
+                        (node.lineno, node.col_offset, node.args[0].id)
+                    )
+            stack.extend(ast.iter_child_nodes(node))
+        for line, col, name in sorted(draws):
+            if name not in armed:
+                continue
+            if not armed[name] and (line, col) not in emitted:
+                emitted.add((line, col))
+                out.append(Finding(
+                    rule="prng-reuse", path=path, line=line, col=col,
+                    message=(
+                        f"PRNG key '{name}' already consumed by an earlier "
+                        f"jax.random draw in "
+                        f"'{getattr(fn, 'name', '<fn>')}' — correlated "
+                        f"randomness"
+                    ),
+                    hint="jax.random.split the key (one subkey per draw) "
+                         "or fold_in a distinct constant",
+                    source=_src_line(src_lines, line),
+                ))
+            armed[name] = False
+
+    def run(stmts: list[ast.stmt], armed: dict[str, bool]) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # scanned as its own function
+            if isinstance(st, ast.If):
+                scan_expr(st.test, armed)
+                a_then, a_else = dict(armed), dict(armed)
+                run(st.body, a_then)
+                run(st.orelse, a_else)
+                for k in set(a_then) | set(a_else):
+                    armed[k] = a_then.get(k, False) and a_else.get(k, False)
+                continue
+            if isinstance(st, (ast.For, ast.AsyncFor)):
+                scan_expr(st.iter, armed)
+                # two passes (abstract-interpretation widening): a draw
+                # from a loop-invariant key is fine on iteration 1 and
+                # correlated on iteration 2 — the second pass sees the
+                # disarmed state the first pass left behind
+                run(st.body, armed)
+                run(st.body, armed)
+                run(st.orelse, armed)
+                continue
+            if isinstance(st, ast.While):
+                scan_expr(st.test, armed)
+                run(st.body, armed)
+                run(st.body, armed)
+                run(st.orelse, armed)
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    scan_expr(item.context_expr, armed)
+                run(st.body, armed)
+                continue
+            if isinstance(st, ast.Try):
+                run(st.body, armed)
+                for h in st.handlers:
+                    run(h.body, dict(armed))
+                run(st.orelse, armed)
+                run(st.finalbody, armed)
+                continue
+            scan_expr(getattr(st, "value", None) or st, armed)
+
+            def _derives(expr: ast.AST | None) -> bool:
+                # a derive call, possibly indexed: jax.random.split(k)[0]
+                while isinstance(expr, ast.Subscript):
+                    expr = expr.value
+                return (isinstance(expr, ast.Call)
+                        and random_attr(expr) in _DERIVE)
+
+            targets: list[ast.AST] = []
+            if isinstance(st, ast.Assign) and _derives(st.value):
+                targets = list(st.targets)
+            elif isinstance(st, ast.AnnAssign) and _derives(st.value):
+                targets = [st.target]
+            for t in targets:
+                elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                for e in elts:
+                    if isinstance(e, ast.Name):
+                        armed[e.id] = True
+
+    armed: dict[str, bool] = {}
+    # parameters arm too: a key RECEIVED by the function is fresh exactly
+    # once — two draws from it are just as correlated as from a local key
+    fn_args = getattr(fn, "args", None)
+    if fn_args is not None:
+        for a in (fn_args.posonlyargs + fn_args.args + fn_args.kwonlyargs):
+            armed[a.arg] = True
+    run(getattr(fn, "body", []), armed)
+
+
+def _is_int32_dtype(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and node.value == "int32":
+        return True
+    d = _dotted(node)
+    return bool(d) and d.rsplit(".", 1)[-1] in _INT32_NAMES
+
+
+def _check_int32_cast(
+    path: str, tree: ast.AST, src_lines: list[str], out: list[Finding]
+) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # X.astype(int32) where X is arithmetic
+        if (isinstance(node.func, ast.Attribute) and node.func.attr == "astype"
+                and node.args and _is_int32_dtype(node.args[0])):
+            val = node.func.value
+            risky = (
+                isinstance(val, ast.BinOp)
+                and isinstance(val.op, _MUTATING_BINOPS)
+            ) or (
+                isinstance(val, ast.Call)
+                and _dotted(val.func).rsplit(".", 1)[-1] in _ACCUM_CALLS
+            )
+            if risky:
+                out.append(Finding(
+                    rule="int32-cast", path=path,
+                    line=node.lineno, col=node.col_offset,
+                    message=(
+                        "astype(int32) of an arithmetic result — the "
+                        "product/sum can exceed 2**31-1 and wrap silently"
+                    ),
+                    hint="bound the value first (clip / guard the operand "
+                         "ranges) or keep the accumulation in int64",
+                    source=_src_line(src_lines, node.lineno),
+                ))
+        # clip(X.astype(int32), ...) / X.astype(int32).clip(...): cast runs
+        # before the clip, so the clip bounds the already-wrapped value
+        def _is_cast(call: ast.AST) -> bool:
+            return (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "astype"
+                    and call.args and _is_int32_dtype(call.args[0]))
+
+        clipped = None
+        if (_dotted(node.func).rsplit(".", 1)[-1] == "clip"
+                and node.args and _is_cast(node.args[0])):
+            clipped = node.args[0]
+        elif (isinstance(node.func, ast.Attribute) and node.func.attr == "clip"
+                and _is_cast(node.func.value)):
+            clipped = node.func.value
+        if clipped is not None:
+            out.append(Finding(
+                rule="int32-cast", path=path,
+                line=node.lineno, col=node.col_offset,
+                message=(
+                    "clip applied AFTER astype(int32): a >=2**31 input has "
+                    "already wrapped to an arbitrary in-range value the "
+                    "clip will happily keep"
+                ),
+                hint="clip in the wide dtype, then cast: "
+                     "x.clip(lo, hi).astype(int32)",
+                source=_src_line(src_lines, node.lineno),
+            ))
+
+
+_LOGGING_HINTS = {"warning", "error", "exception", "critical", "info",
+                  "debug", "log", "print_exc", "print_exception", "print"}
+
+
+def _check_swallowed(
+    path: str, tree: ast.AST, src_lines: list[str], out: list[Finding]
+) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        t = node.type
+
+        def _is_broad(tp: ast.AST | None) -> bool:
+            if tp is None:
+                return True
+            if isinstance(tp, ast.Tuple):  # except (Exception, X): ...
+                return any(_is_broad(e) for e in tp.elts)
+            return (isinstance(tp, (ast.Name, ast.Attribute))
+                    and _dotted(tp).rsplit(".", 1)[-1]
+                    in ("Exception", "BaseException"))
+
+        if not _is_broad(t):
+            continue
+        reraises = any(isinstance(n, ast.Raise) for n in ast.walk(node))
+        uses_exc = node.name is not None and any(
+            isinstance(n, ast.Name) and n.id == node.name
+            for b in node.body for n in ast.walk(b)
+        )
+        logs = any(
+            isinstance(n, ast.Call) and (
+                (isinstance(n.func, ast.Name) and n.func.id in _LOGGING_HINTS)
+                or (isinstance(n.func, ast.Attribute)
+                    and n.func.attr in _LOGGING_HINTS)
+            )
+            for b in node.body for n in ast.walk(b)
+        )
+        if not (reraises or uses_exc or logs):
+            if t is None:
+                caught = "bare except"
+            elif isinstance(t, ast.Tuple):
+                caught = ("except ("
+                          + ", ".join(_dotted(e) or "?" for e in t.elts) + ")")
+            else:
+                caught = f"except {_dotted(t)}"
+            out.append(Finding(
+                rule="swallowed-exception", path=path,
+                line=node.lineno, col=node.col_offset,
+                message=(
+                    f"{caught} swallows the error (no re-raise, no log, "
+                    f"exception unused) — a retry/breaker/swap path failing "
+                    f"here vanishes"
+                ),
+                hint="narrow the exception type, log it, or suppress with "
+                     "a justified da:allow[swallowed-exception]",
+                source=_src_line(src_lines, node.lineno),
+            ))
+
+
+# --------------------------------------------------------------------------
+# driver
+# --------------------------------------------------------------------------
+
+def parse_files(files: dict[str, str]) -> dict[str, ast.Module]:
+    """Parse once for every engine-1 pass (ast rules AND guarded-by)."""
+    trees = {}
+    for path, src in sorted(files.items()):
+        try:
+            trees[path] = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            raise ValueError(f"{path}: syntax error: {e}") from e
+    return trees
+
+
+def analyze_modules(
+    files: dict[str, str], trees: dict[str, ast.Module] | None = None
+) -> list[Finding]:
+    """{repo-relative path: source} -> findings (engine 1, minus guarded-by
+    which lives in guarded_by.py).  Pass ``trees`` (from
+    :func:`parse_files`) to avoid re-parsing."""
+    trees = parse_files(files) if trees is None else trees
+    modules: list[_ModuleInfo] = []
+    for path in sorted(files):
+        info = _ModuleInfo(path, trees[path])
+        _collect(info)
+        modules.append(info)
+    traced = compute_traced(modules)
+    out: list[Finding] = []
+    for info in modules:
+        src_lines = files[info.path].splitlines()
+        aliases = _jax_random_aliases(info.tree)
+        for name in sorted(traced.get(info.path, ())):
+            for fn in info.functions.get(name, ()):
+                _check_traced_body(info.path, fn, src_lines, out, aliases)
+        for defs in info.functions.values():
+            for fn in defs:
+                _check_prng_reuse(info.path, fn, src_lines, out, aliases)
+        _check_int32_cast(info.path, info.tree, src_lines, out)
+        _check_swallowed(info.path, info.tree, src_lines, out)
+    return out

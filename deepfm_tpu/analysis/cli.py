@@ -1,0 +1,231 @@
+"""``python -m deepfm_tpu.analysis`` — run the static-analysis suite.
+
+    python -m deepfm_tpu.analysis deepfm_tpu/            # engine 1 (AST)
+    python -m deepfm_tpu.analysis deepfm_tpu/ --trace-audit   # + engine 2
+    python -m deepfm_tpu.analysis deepfm_tpu/ --format json
+    python -m deepfm_tpu.analysis deepfm_tpu/ --write-baseline
+
+Exit codes: 0 — clean (or everything baselined/suppressed); 1 — new
+findings vs the baseline; 2 — usage/internal error.
+
+Engine 1 parses only (no imports, safe anywhere).  Engine 2
+(``--trace-audit``) imports jax and the real entrypoints to check
+lowering-level contracts; it needs a working jax install but never
+executes a training step.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .ast_rules import analyze_modules
+from .baseline import load_baseline, partition, write_baseline
+from .findings import (
+    RULES,
+    Finding,
+    apply_suppressions,
+    fingerprint_findings,
+    load_suppressions,
+)
+from .guarded_by import check_guarded_by
+
+DEFAULT_BASELINE = "analysis_baseline.json"
+
+
+def _find_root(paths: list[str]) -> str:
+    """Anchor finding paths (and so fingerprints) to the repo root, not the
+    invoker's cwd: walk up from the first analyzed path to the enclosing
+    .git.  An editor/CI invocation from any directory then produces the
+    same repo-relative paths the checked-in baseline was written with."""
+    probe = os.path.abspath(paths[0]) if paths else os.getcwd()
+    if os.path.isfile(probe):
+        probe = os.path.dirname(probe)
+    d = probe
+    while True:
+        if os.path.exists(os.path.join(d, ".git")):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            return os.getcwd()
+        d = parent
+
+
+def _collect_files(paths: list[str], root: str) -> dict[str, str]:
+    files: dict[str, str] = {}
+    for p in paths:
+        ap = os.path.abspath(p)
+        if os.path.isfile(ap) and ap.endswith(".py"):
+            files[os.path.relpath(ap, root).replace(os.sep, "/")] = ap
+        elif os.path.isdir(ap):
+            for dirpath, dirnames, names in os.walk(ap):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git", "_build")]
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        full = os.path.join(dirpath, n)
+                        files[os.path.relpath(full, root).replace(os.sep, "/")] = full
+        else:
+            raise FileNotFoundError(f"no such file or directory: {p}")
+    out = {}
+    for rel, full in sorted(files.items()):
+        with open(full, encoding="utf-8") as f:
+            out[rel] = f.read()
+    return out
+
+
+def run_ast_engine(files: dict[str, str]) -> list[Finding]:
+    """Engine 1 over {relpath: source}: AST rules + guarded-by (one shared
+    parse), with da:allow suppressions applied."""
+    from .ast_rules import parse_files
+
+    trees = parse_files(files)
+    findings = analyze_modules(files, trees)
+    for path, src in sorted(files.items()):
+        findings.extend(check_guarded_by(path, src, trees[path]))
+    sups = {path: load_suppressions(src) for path, src in files.items()}
+    findings = apply_suppressions(findings, sups)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    fingerprint_findings(findings)
+    return findings
+
+
+def _render_text(new, accepted, stale, *, out=sys.stdout) -> None:
+    for f in new:
+        print(f.render(), file=out)
+        print(f"    fingerprint: {f.fingerprint}", file=out)
+    if accepted:
+        print(f"-- {len(accepted)} baselined finding(s) (accepted debt):",
+              file=out)
+        for f in accepted:
+            print(f"   {f.path}:{f.line}: [{f.rule}] {f.fingerprint}",
+                  file=out)
+    if stale:
+        print(f"-- {len(stale)} stale baseline entr(ies) — debt paid; "
+              f"rerun with --write-baseline to shrink the file", file=out)
+    print(
+        f"analysis: {len(new)} new, {len(accepted)} baselined, "
+        f"{len(stale)} stale",
+        file=out,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deepfm_tpu.analysis",
+        description="JAX-aware static analysis: AST rules + trace-time audits",
+    )
+    ap.add_argument("paths", nargs="*", default=["deepfm_tpu"],
+                    help="files/directories to analyze (default: deepfm_tpu)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline JSON (default: ./{DEFAULT_BASELINE} "
+                         f"when present)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept current findings as the new baseline")
+    ap.add_argument("--trace-audit", action="store_true",
+                    help="also run the trace-time contract audit (engine 2; "
+                         "imports jax)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in RULES.items():
+            print(f"{rule}: {desc}")
+        return 0
+
+    try:
+        root = _find_root(args.paths or ["deepfm_tpu"])
+        files = _collect_files(args.paths or ["deepfm_tpu"], root)
+        findings = run_ast_engine(files)
+    except (OSError, ValueError) as e:
+        # unanalyzable input (missing/unreadable path, syntax error) is an
+        # exit-2 analyzer failure, never conflated with exit-1 findings
+        print(f"analysis: {e}", file=sys.stderr)
+        return 2
+
+    if args.trace_audit:
+        try:
+            from .trace_audit import run_trace_audit
+
+            findings.extend(run_trace_audit())
+        except Exception as e:
+            # a crashing audit (broken jax install, model import error) is
+            # an analyzer failure (exit 2) — the audits themselves report
+            # contract VIOLATIONS as findings, never as exceptions
+            print(f"analysis: trace audit crashed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 2
+        fingerprint_findings(findings)
+
+    # the default baseline lives at the ROOT the finding paths anchor to —
+    # resolving against cwd would make cross-cwd runs ignore the checked-in
+    # file (and --write-baseline scatter copies around the filesystem)
+    default_baseline = os.path.join(root, DEFAULT_BASELINE)
+    baseline_path = args.baseline or (
+        default_baseline if os.path.exists(default_baseline) else None
+    )
+    if args.write_baseline:
+        path = args.baseline or default_baseline
+        # a subset run must MERGE, not truncate: rewriting the root
+        # baseline from `analysis deepfm_tpu/serve --write-baseline` would
+        # drop every other file's accepted debt and fail the next full run
+        analyzed_dirs = tuple(
+            os.path.relpath(os.path.abspath(p), root).replace(os.sep, "/")
+            + "/"
+            for p in (args.paths or ["deepfm_tpu"])
+            if os.path.isdir(p)
+        )
+
+        def _outside_analyzed(entry_path: str | None) -> bool:
+            # under an analyzed dir but absent from `files` = deleted file:
+            # its debt is paid, drop it; genuinely outside the set = keep
+            if entry_path is None or entry_path in files:
+                return False
+            return not entry_path.startswith(analyzed_dirs)
+
+        preserved: list = []
+        try:
+            for fp, e in load_baseline(path).items():
+                if _outside_analyzed(e.get("path")):
+                    f = Finding(rule=e.get("rule", "?"),
+                                path=e.get("path", "?"),
+                                line=int(e.get("line", 0)), col=0,
+                                message=e.get("message", ""), source="")
+                    f.fingerprint = fp
+                    preserved.extend([f] * int(e.get("count", 1)))
+        except (ValueError, OSError, json.JSONDecodeError):
+            preserved = []  # unreadable old baseline: rewrite from scratch
+        write_baseline(path, findings + preserved)
+        print(f"analysis: wrote {len(findings)} finding(s) to {path}"
+              + (f" (+{len(preserved)} preserved outside the analyzed set)"
+                 if preserved else ""))
+        return 0
+    try:
+        baseline = load_baseline(baseline_path) if baseline_path else {}
+    except (ValueError, OSError, json.JSONDecodeError) as e:
+        # a corrupt/mismatched baseline is an analyzer failure (exit 2),
+        # never "new findings" (exit 1)
+        print(f"analysis: unreadable baseline {baseline_path}: {e}",
+              file=sys.stderr)
+        return 2
+    new, accepted, stale = partition(findings, baseline)
+
+    if args.format == "json":
+        json.dump(
+            {
+                "schema": 1,
+                "new": [f.to_dict() for f in new],
+                "baselined": [f.to_dict() for f in accepted],
+                "stale_baseline": stale,
+                "counts": {"new": len(new), "baselined": len(accepted),
+                           "stale": len(stale)},
+            },
+            sys.stdout, indent=2,
+        )
+        print()
+    else:
+        _render_text(new, accepted, stale)
+    return 1 if new else 0

@@ -1,0 +1,199 @@
+"""Finding model shared by both analysis engines.
+
+A finding is one (rule, location, message) triple with a *fingerprint* —
+a content hash of the rule id, the repo-relative path, and the normalized
+source line — so the baseline ratchet survives unrelated line insertions:
+moving a finding does not make it "new", editing the flagged line does.
+Identical findings deliberately SHARE a fingerprint; the baseline ratchets
+their count (baseline.py), so fixing one of N cannot renumber the rest.
+
+Suppression syntax (checked by :func:`load_suppressions`):
+
+    something_flagged()  # da:allow[rule-id] one-line justification
+
+The justification is MANDATORY: a suppression without one is itself a
+finding (``suppression-missing-reason``), so silencing the analyzer always
+leaves a written trace of *why* in the diff.  The comment may also sit on
+the line directly above the flagged statement (for long lines).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from dataclasses import asdict, dataclass, field
+
+# rule-id -> one-line description; the CLI renders this as the rule table
+RULES = {
+    "tracer-host-op": (
+        "host operation (float()/int()/bool()/.item()/.tolist()/np.*) on a "
+        "value inside a jit-reachable function — concretizes the tracer or "
+        "forces an implicit device sync"
+    ),
+    "traced-nondeterminism": (
+        "wall-clock / python-random call inside a jit-reachable function — "
+        "the value is baked in at trace time and silently frozen across "
+        "calls (and differs across checkpoint replays)"
+    ),
+    "prng-reuse": (
+        "same PRNG key consumed by more than one jax.random draw without "
+        "an intervening split/fold_in — the draws are correlated"
+    ),
+    "int32-cast": (
+        "overflow-prone int32 cast: astype(int32) of an arithmetic result, "
+        "or clip() applied AFTER the cast (a >=2**31 value wraps before the "
+        "clip can bound it)"
+    ),
+    "swallowed-exception": (
+        "broad except (bare / Exception / BaseException) whose handler "
+        "neither re-raises, logs, nor uses the exception — failures in "
+        "retry/breaker/swap paths vanish silently"
+    ),
+    "guarded-by": (
+        "attribute accessed under a self._lock-style context elsewhere in "
+        "the class is mutated outside any lock-held region — data race "
+        "with the thread that honors the lock"
+    ),
+    "suppression-missing-reason": (
+        "da:allow[...] suppression without a one-line justification"
+    ),
+    "unused-suppression": (
+        "da:allow[...] comment that matched no finding — dead after a fix, "
+        "and a silent trap for the NEXT finding on that line"
+    ),
+    # trace-time (engine 2) rules
+    "trace-transfer": (
+        "tracing/lowering a jitted entrypoint performed an implicit "
+        "host->device transfer (jax.transfer_guard('disallow') tripped)"
+    ),
+    "trace-recompile": (
+        "an admissible request shape does not map onto a precompiled "
+        "bucket executable — a live request would pay a compile"
+    ),
+    "trace-donation": (
+        "train-step state buffers are not donated — every step pays a "
+        "full parameter copy in HBM"
+    ),
+    "trace-dtype": (
+        "silent dtype promotion: float64 (or an unexpected widening) in a "
+        "jitted entrypoint's signature"
+    ),
+}
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int          # 1-based
+    col: int
+    message: str
+    hint: str = ""
+    fingerprint: str = ""
+    source: str = ""   # stripped source line (context for the report)
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    def render(self) -> str:
+        out = f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+        if self.hint:
+            out += f"\n    fix: {self.hint}"
+        return out
+
+
+def fingerprint_findings(findings: list[Finding]) -> None:
+    """Assign stable fingerprints in place: rule + path + normalized source
+    line, deliberately NOT occurrence-indexed — N identical lines share one
+    fingerprint and the baseline ratchets their COUNT (baseline.py), so
+    fixing the first of N cannot renumber (and un-baseline) the survivors."""
+    for f in findings:
+        raw = "|".join((f.rule, f.path, f.source.strip()))
+        f.fingerprint = hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+
+_SUPPRESS_RE = re.compile(r"#\s*da:allow\[([A-Za-z0-9_,-]+)\]\s*(.*)$")
+
+
+@dataclass
+class Suppression:
+    rules: tuple[str, ...]
+    line: int
+    reason: str
+    used: bool = field(default=False)
+
+
+def load_suppressions(src: str) -> list[Suppression]:
+    """Parse ``da:allow`` comments — COMMENT tokens only, so a docstring
+    *showing* the syntax is not itself a suppression."""
+    import io
+    import tokenize
+
+    out = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(src).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        tokens = []
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if m:
+            rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+            out.append(Suppression(
+                rules=rules, line=tok.start[0], reason=m.group(2).strip()
+            ))
+    return out
+
+
+def apply_suppressions(
+    findings: list[Finding], by_path: dict[str, list[Suppression]]
+) -> list[Finding]:
+    """Drop findings covered by a same-line or line-above ``da:allow``;
+    emit a finding for any suppression lacking a justification."""
+    kept: list[Finding] = []
+    for f in findings:
+        sups = by_path.get(f.path, [])
+        hit = next(
+            (s for s in sups
+             if f.rule in s.rules and s.line in (f.line, f.line - 1)),
+            None,
+        )
+        if hit is None:
+            kept.append(f)
+        else:
+            hit.used = True
+    for path, sups in by_path.items():
+        for s in sups:
+            # fingerprint on the comment's own content (source field) —
+            # with an empty source, every suppression finding in a file
+            # would share one fingerprint and a single baselined entry
+            # would silently accept all future dead/reason-less comments
+            if not s.reason:
+                kept.append(Finding(
+                    rule="suppression-missing-reason",
+                    path=path, line=s.line, col=0,
+                    message=(
+                        f"da:allow[{','.join(s.rules)}] needs a one-line "
+                        f"justification after the bracket"
+                    ),
+                    hint="write WHY the finding is acceptable, not that it is",
+                    source=f"da:allow[{','.join(s.rules)}]",
+                ))
+            elif not s.used:
+                # unlike stale BASELINE entries (non-fatal: regenerated),
+                # a dead inline comment is immediately actionable — delete
+                # it, or it silently swallows the next same-rule finding
+                # introduced on its line
+                kept.append(Finding(
+                    rule="unused-suppression",
+                    path=path, line=s.line, col=0,
+                    message=(
+                        f"da:allow[{','.join(s.rules)}] matched no finding "
+                        f"— the debt it justified is gone"
+                    ),
+                    hint="delete the comment (the analyzer re-flags if the "
+                         "finding ever returns)",
+                    source=f"da:allow[{','.join(s.rules)}] {s.reason}",
+                ))
+    return kept

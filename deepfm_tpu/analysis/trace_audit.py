@@ -1,0 +1,341 @@
+"""Engine 2 — trace-time contract audit.
+
+Imports the REAL entrypoints (the jitted predict/train constructions the
+serving and training stacks run) and verifies lowering-level invariants
+without executing a step — everything here works on abstract
+``ShapeDtypeStruct`` values, so the audit is shape/dtype/lowering truth,
+not a benchmark:
+
+* **transfer audit** — trace + lower the weight-parameterized predict
+  (``serve.reload.build_predict_with``) and the canonical train step
+  (``train.step.jitted_train_step``) under
+  ``jax.transfer_guard("disallow")``: any implicit host→device transfer
+  during tracing/lowering (a stray ``jnp.asarray(host_thing)``, an
+  uncommitted constant) raises, proving the executables move data only
+  through their declared arguments.
+* **recompile audit** — enumerate the MicroBatcher's bucket shapes and
+  prove every admissible request size maps onto a precompiled bucket
+  (``serve.batcher.pick_bucket`` + the admission chunking contract):
+  exactly ``len(buckets)`` executables exist and no live shape escapes
+  onto the compile path.
+* **swap-is-a-cache-hit audit** — lower ``predict_with`` with two
+  DIFFERENT abstract payloads of identical spec and require identical
+  input signatures and lowered modules: the jit cache key depends on the
+  payload's shapes/dtypes only, so publishing version N+1 (same tree) can
+  never recompile mid-traffic.  Also asserts the payload leaves appear as
+  lowered *parameters*, not baked-in constants.
+* **donation audit** — the train step's state argument must be donated
+  (buffers update in place in HBM); verified from the lowered
+  ``args_info``, i.e. what actually reached XLA, not what the call site
+  intended.
+* **dtype audit** — no float64 anywhere in the lowered signatures (a
+  silent x64 upgrade doubles bytes and halves serving throughput before
+  any test notices) and the predict output is exactly float32 (no
+  surprise bf16 widening of the wire format).
+
+Failures are reported as the same :class:`~.findings.Finding` records as
+engine 1 (rules ``trace-transfer`` / ``trace-recompile`` /
+``trace-donation`` / ``trace-dtype``) so the CLI, baseline, and JSON
+output treat both engines uniformly.
+"""
+
+from __future__ import annotations
+
+from .findings import Finding
+
+# small but structurally faithful: all model families keep their real
+# layer stack; only the table sizes shrink so abstract lowering stays
+# fast enough for a tier-1 test
+_AUDIT_OVERRIDES = {"feature_size": 997, "field_size": 8}
+
+
+def _default_buckets() -> tuple[int, ...]:
+    """The engine's REAL default shapes (serve.batcher.DEFAULT_BUCKETS) —
+    imported, not copied, so a serving-default change re-points the audit
+    automatically.  Deferred import: this module must stay importable
+    before jax-adjacent deps load."""
+    from ..serve.batcher import DEFAULT_BUCKETS
+
+    return DEFAULT_BUCKETS
+
+
+def _finding(rule: str, message: str, hint: str = "", where: str = "",
+             slug: str = "") -> Finding:
+    # `slug` stands in for the source line in the fingerprint (trace
+    # findings have no source line): a stable per-contract token, so two
+    # different trace-dtype defects in one file never share a fingerprint
+    # (and a baselined one can never mask a fresh regression)
+    return Finding(
+        rule=rule, path=where or "deepfm_tpu/analysis/trace_audit.py",
+        line=0, col=0, message=message, hint=hint, source=slug or message,
+    )
+
+
+def _audit_cfg(model_name: str = "deepfm"):
+    from ..core.config import Config
+
+    return Config().with_overrides(
+        model={**_AUDIT_OVERRIDES, "model_name": model_name}
+    )
+
+
+def _abstract_batch(cfg, rows: int):
+    import jax
+    import jax.numpy as jnp
+
+    f = cfg.model.field_size
+    return {
+        "feat_ids": jax.ShapeDtypeStruct((rows, f), jnp.int64),
+        "feat_vals": jax.ShapeDtypeStruct((rows, f), jnp.float32),
+        "label": jax.ShapeDtypeStruct((rows,), jnp.float32),
+    }
+
+
+def _abstract_payload(cfg):
+    import jax
+
+    from ..models.base import get_model
+
+    model = get_model(cfg.model)
+    params, model_state = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), cfg.model)
+    )
+    return model, {"params": params, "model_state": model_state}
+
+
+def audit_predict(cfg=None) -> list[Finding]:
+    """Transfer + dtype + swap-cache-hit contracts on the hot-reload
+    predict path."""
+    import jax
+
+    from ..serve.reload import build_predict_with
+
+    out: list[Finding] = []
+    cfg = cfg or _audit_cfg()
+    where = "deepfm_tpu/serve/reload.py"
+    model, payload = _abstract_payload(cfg)
+    predict_with = build_predict_with(model, cfg)
+    f = cfg.model.field_size
+    args = lambda b: (  # noqa: E731
+        jax.ShapeDtypeStruct((b, f), jax.numpy.int64),
+        jax.ShapeDtypeStruct((b, f), jax.numpy.float32),
+    )
+    buckets = _default_buckets()
+    lowered = {}
+    try:
+        with jax.transfer_guard("disallow"):
+            for b in buckets:
+                lowered[b] = predict_with.lower(payload, *args(b))
+    except Exception as e:
+        out.append(_finding(
+            "trace-transfer",
+            f"lowering predict_with under transfer_guard('disallow') "
+            f"raised {type(e).__name__}: {e}",
+            hint="the predict path moved host data implicitly while "
+                 "tracing — route every array through the arguments",
+            where=where, slug="predict-transfer-guard",
+        ))
+        return out
+    # dtype: output exactly f32, nothing f64 in the signature
+    for b, lo in lowered.items():
+        flat_in = jax.tree_util.tree_leaves(lo.in_avals)
+        flat_out = jax.tree_util.tree_leaves(lo.out_info)
+        bad64 = [a for a in flat_in + flat_out
+                 if str(getattr(a, "dtype", "")) == "float64"]
+        if bad64:
+            out.append(_finding(
+                "trace-dtype",
+                f"predict lowering at bucket {b} carries float64 avals "
+                f"({len(bad64)} leaves) — silent x64 promotion",
+                hint="check jax_enable_x64 and literal dtypes in the "
+                     "model stack",
+                where=where, slug="predict-f64",
+            ))
+            break
+    out_dtypes = {
+        str(a.dtype) for a in jax.tree_util.tree_leaves(
+            lowered[buckets[0]].out_info
+        )
+    }
+    if out_dtypes != {"float32"}:
+        out.append(_finding(
+            "trace-dtype",
+            f"predict output dtype(s) {sorted(out_dtypes)} != "
+            f"{{'float32'}} — the wire format widened or narrowed",
+            hint="probabilities serve as f32; cast at the boundary",
+            where=where, slug="predict-out-dtype",
+        ))
+    # swap == cache hit: a second, DISTINCT abstract payload of identical
+    # spec must produce an identical jit signature and module
+    _, payload2 = _abstract_payload(cfg)
+    b0 = buckets[0]
+    lo2 = predict_with.lower(payload2, *args(b0))
+    if lowered[b0].in_avals != lo2.in_avals:
+        out.append(_finding(
+            "trace-recompile",
+            "lowering predict_with with a same-spec replacement payload "
+            "changed the input signature — a hot swap would MISS the jit "
+            "cache and recompile mid-traffic",
+            hint="keep the payload a plain argument pytree; do not bake "
+                 "version-dependent values into the trace",
+            where=where, slug="swap-signature-mismatch",
+        ))
+    elif lowered[b0].as_text() != lo2.as_text():
+        out.append(_finding(
+            "trace-recompile",
+            "same-spec payloads lowered to different modules — payload "
+            "identity leaked into the executable",
+            hint="no id()/hash()/host reads of the payload inside "
+                 "predict_with",
+            where=where, slug="swap-module-mismatch",
+        ))
+    # payload leaves must be parameters of the executable, not constants
+    n_payload_leaves = len(jax.tree_util.tree_leaves(payload))
+    n_in_leaves = len(jax.tree_util.tree_leaves(lowered[b0].in_avals))
+    if n_in_leaves != n_payload_leaves + 2:
+        out.append(_finding(
+            "trace-recompile",
+            f"lowered predict has {n_in_leaves} input leaves, expected "
+            f"{n_payload_leaves} payload leaves + ids + vals — weights "
+            f"were baked in as constants (every publish would recompile)",
+            hint="jit the params-as-argument form "
+                 "(serve.reload.build_predict_with)",
+            where=where, slug="predict-params-baked",
+        ))
+    return out
+
+
+def audit_buckets(
+    buckets=None, *, max_probe: int | None = None
+) -> list[Finding]:
+    """Every admissible request size must land on a precompiled bucket
+    shape.  Admission chunks oversized requests to <= max(buckets) rows
+    (serve/batcher.py score()), so the admissible dispatch sizes are
+    1..max(buckets); each must map into the bucket set and never shrink a
+    request (padding only)."""
+    from ..serve.batcher import admission_starts, pick_bucket
+
+    out: list[Finding] = []
+    where = "deepfm_tpu/serve/batcher.py"
+    buckets = _default_buckets() if buckets is None else buckets
+    bset = set(buckets)
+    cap = max(buckets)
+    probe = max_probe or 2 * cap
+    for n in range(1, probe + 1):
+        # the engine's own admission split (same range score() slices at)
+        chunks = [min(cap, n - s) for s in admission_starts(n, cap)]
+        for rows in chunks:
+            b = pick_bucket(tuple(sorted(bset)), rows)
+            if b not in bset:
+                out.append(_finding(
+                    "trace-recompile",
+                    f"request of {n} rows dispatches {rows} rows onto "
+                    f"shape {b}, which is NOT a precompiled bucket "
+                    f"{sorted(bset)} — a live request would pay a compile",
+                    where=where, slug="bucket-offbucket",
+                ))
+                return out
+            if b < rows:
+                out.append(_finding(
+                    "trace-recompile",
+                    f"bucket {b} smaller than the {rows}-row chunk it was "
+                    f"picked for — rows would be truncated",
+                    where=where, slug="bucket-shrink",
+                ))
+                return out
+    return out
+
+
+def audit_train_step(cfg=None) -> list[Finding]:
+    """Transfer + donation + dtype contracts on the canonical train step."""
+    import jax
+
+    from ..train.step import create_train_state, jitted_train_step
+
+    out: list[Finding] = []
+    cfg = cfg or _audit_cfg()
+    where = "deepfm_tpu/train/step.py"
+    state = jax.eval_shape(lambda: create_train_state(cfg))
+    batch = _abstract_batch(cfg, cfg.data.batch_size)
+    step = jitted_train_step(cfg)
+    try:
+        with jax.transfer_guard("disallow"):
+            lowered = step.lower(state, batch)
+    except Exception as e:
+        out.append(_finding(
+            "trace-transfer",
+            f"lowering the train step under transfer_guard('disallow') "
+            f"raised {type(e).__name__}: {e}",
+            hint="hoist host-side data (schedules, constants) into traced "
+                 "arguments or jnp literals",
+            where=where, slug="train-transfer-guard",
+        ))
+        return out
+    # donation: the state argument's leaves must be donated in what
+    # actually reached XLA
+    try:
+        args_info = lowered.args_info
+        state_info = args_info[0][0]
+        donated = [bool(getattr(a, "donated", False))
+                   for a in jax.tree_util.tree_leaves(state_info)]
+    except (AttributeError, IndexError, KeyError, TypeError):
+        # AOT API drift: fall through to the explicit "unverified" finding
+        donated = []
+    if donated and not all(donated):
+        n_bad = sum(1 for d in donated if not d)
+        out.append(_finding(
+            "trace-donation",
+            f"{n_bad}/{len(donated)} train-state leaves are NOT donated — "
+            f"each step copies those parameter/optimizer buffers instead "
+            f"of updating in place",
+            hint="jit via train.step.jitted_train_step (donate_argnums=(0,))",
+            where=where, slug="train-not-donated",
+        ))
+    elif not donated:
+        out.append(_finding(
+            "trace-donation",
+            "could not read donation info from the lowered train step "
+            "(args_info missing) — the donation contract is unverified",
+            hint="jax upgrade changed the AOT API; update the audit",
+            where=where, slug="train-donation-unverified",
+        ))
+    # dtype: the new state must match the old leaf-for-leaf (a widening
+    # state would recompile next step and double checkpoint bytes), and
+    # nothing may be float64
+    new_state = lowered.out_info[0]
+    old_specs = [(str(a.dtype), tuple(a.shape))
+                 for a in jax.tree_util.tree_leaves(state)]
+    new_specs = [(str(a.dtype), tuple(a.shape))
+                 for a in jax.tree_util.tree_leaves(new_state)]
+    if old_specs != new_specs:
+        out.append(_finding(
+            "trace-dtype",
+            "train step output state specs differ from its input state — "
+            "dtype/shape drift means a recompile every step and "
+            "checkpoint bloat",
+            hint="keep updates in the parameter dtype (check optimizer "
+                 "and loss literals)",
+            where=where, slug="train-state-drift",
+        ))
+    f64 = [a for a in jax.tree_util.tree_leaves(lowered.out_info)
+           if str(getattr(a, "dtype", "")) == "float64"]
+    if f64:
+        out.append(_finding(
+            "trace-dtype",
+            f"train step emits float64 ({len(f64)} leaves) — silent x64 "
+            f"promotion on this backend",
+            hint="check jax_enable_x64 and python-float literals",
+            where=where, slug="train-f64",
+        ))
+    return out
+
+
+def run_trace_audit(cfg=None) -> list[Finding]:
+    """All engine-2 audits against the real entrypoints (abstract values
+    only; no step executes).  Importing jax is the price of admission —
+    callers that only want engine 1 never reach this module."""
+    findings: list[Finding] = []
+    findings.extend(audit_predict(cfg))
+    findings.extend(audit_buckets())
+    findings.extend(audit_train_step(cfg))
+    return findings

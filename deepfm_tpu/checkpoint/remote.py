@@ -201,8 +201,9 @@ class RemoteCheckpointer:
         if self._failed_steps:
             try:
                 self._failed_steps -= set(self._remote_steps())
+            # da:allow[swallowed-exception] listing outage: re-uploading a committed step is idempotent waste, losing one is not
             except Exception:
-                pass  # listing down: retry the uploads anyway (idempotent)
+                pass
         return sorted(self._failed_steps)
 
     def _try_upload_many(self, steps: list[int]) -> None:

@@ -27,6 +27,7 @@ def is_tpu_backend() -> bool:
         if d.platform == "tpu":
             return True
         return "tpu" in getattr(d, "device_kind", "").lower()
+    # da:allow[swallowed-exception] capability probe: no usable backend simply means "not TPU"
     except Exception:
         return False
 
@@ -58,6 +59,7 @@ def xla_flags_supported(flags: str) -> bool:
         import jaxlib.version
 
         version = jaxlib.version.__version__
+    # da:allow[swallowed-exception] cache-key probe: an unimportable jaxlib still yields a usable key
     except Exception:
         version = "unknown"
     key = hashlib.sha1(f"{version}|{flags}".encode()).hexdigest()[:16]
@@ -87,6 +89,7 @@ def xla_flags_supported(flags: str) -> bool:
             [sys.executable, "-c", code], env=env,
             capture_output=True, timeout=120,
         )
+    # da:allow[swallowed-exception] probe subprocess: failure reads as "unsupported this call", cache stays empty
     except Exception:
         # timeout / spawn failure: transient, NOT evidence about the
         # flags — report unsupported for this call but leave the cache
@@ -145,6 +148,7 @@ def sanitize_backend() -> None:
         # sharded-vs-dense parity matters
         try:
             jax.config.update("jax_threefry_partitionable", True)
+        # da:allow[swallowed-exception] older jax without the flag: the default already matches
         except Exception:
             pass
         if requested:

@@ -337,6 +337,7 @@ class ResumingStream:
         time.sleep(min(2.0 ** self._resumes * 0.1, 5.0))
         try:
             self._resp.close()
+        # da:allow[swallowed-exception] best-effort close of a connection already known dead
         except Exception:
             pass
         self._resp = self._store.open_read(self._url, offset=self._offset)
@@ -363,6 +364,7 @@ class ResumingStream:
     def close(self) -> None:
         try:
             self._resp.close()
+        # da:allow[swallowed-exception] best-effort close: the stream owner is done with the body either way
         except Exception:
             pass
 

@@ -111,6 +111,7 @@ def available() -> bool:
     try:
         _load()
         return True
+    # da:allow[swallowed-exception] availability probe: build/load failure means "use the python path"
     except Exception:
         return False
 
@@ -195,6 +196,7 @@ class _Handle:
     def __del__(self):
         try:
             self.close()
+        # da:allow[swallowed-exception] finalizer: interpreter teardown may have dropped the lib handle
         except Exception:
             pass
 

@@ -343,7 +343,11 @@ class EventLogReader:
                 if self._note_read_failure(name, e):
                     continue  # skip-with-metric; later segments proceed
                 return  # stop this pass; retry the segment next poll
-            self._fail_counts.pop(name, None)
+            with self._lock:
+                # clean pass through a previously-flaky segment: clear its
+                # quarantine budget (stats()/other threads read this map
+                # under the same lock)
+                self._fail_counts.pop(name, None)
             self._counts[name] = idx
             if idx < skip:
                 # segment shrank?  immutability violated — fail loudly

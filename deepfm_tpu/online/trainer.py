@@ -35,7 +35,7 @@ import numpy as np
 
 from ..checkpoint import make_checkpointer
 from ..core.config import Config
-from ..train.step import TrainState, create_train_state, make_train_step
+from ..train.step import TrainState, create_train_state, jitted_train_step
 from ..utils import MetricLogger
 from .publisher import ModelPublisher
 from .stream import EventLogReader, StreamCursor, open_tail
@@ -197,7 +197,10 @@ class OnlineTrainer:
                 "online_resume", step=int(state.step),
                 segment=cursor.segment, record=cursor.record,
             )
-        train_step = jax.jit(make_train_step(cfg))
+        # donated state: buffers update in place; `state` is rebound every
+        # iteration and the blocking commit copies to host first, so no
+        # stale reference survives a step
+        train_step = jitted_train_step(cfg)
         step = int(state.step)
         self._log.seed_step(step)
         applied = 0
@@ -282,7 +285,7 @@ def replay_to_state(cfg: Config, *, max_batches: int = 0) -> TrainState:
         batch_size=cfg.data.batch_size,
     )
     state = create_train_state(cfg)
-    train_step = jax.jit(make_train_step(cfg))
+    train_step = jitted_train_step(cfg)
     for batch, _ in reader.batches(max_batches=max_batches):
         state, _m = train_step(state, batch)
     return state

@@ -284,7 +284,11 @@ def _forward(fm_w, fm_v, ids, vals, interpret):
     vals = vals.astype(jnp.float32)
     b, f = ids.shape
     v, k = fm_v.shape
-    ids = jnp.clip(ids.astype(jnp.int32), 0, v - 1)
+    # clip in the incoming (possibly int64) dtype FIRST: casting an
+    # unvalidated id >= 2**31 would wrap onto an arbitrary in-range row
+    # before the clip could bound it (same contract as ops.embedding
+    # narrow_ids)
+    ids = jnp.clip(ids, 0, v - 1).astype(jnp.int32)
     flat = ids.reshape(-1)
     uids, inv, valid, win, sel, first, dist, dma_rows = _dedup_plan(
         flat, _LANES // k

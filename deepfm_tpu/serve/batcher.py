@@ -55,6 +55,32 @@ class OverloadedError(RuntimeError):
     unbounded backlog (mapped to HTTP 503/429-style rejection upstream)."""
 
 
+# the serving engine's default executable shapes — THE definition every
+# consumer (MicroBatcher, load_batching_servable, the trace-time recompile
+# audit) imports, so changing it here re-points the audit automatically
+DEFAULT_BUCKETS = (8, 32, 128, 512)
+
+
+def admission_starts(rows: int, cap: int) -> range:
+    """Chunk offsets ``score()`` splits an admitted request at (each chunk
+    <= ``cap`` rows).  Shared with the recompile audit: the audit's notion
+    of "admissible dispatch size" is derived from this exact split."""
+    return range(0, rows, cap)
+
+
+def pick_bucket(buckets: Sequence[int], rows: int) -> int:
+    """Smallest bucket that fits ``rows`` (the largest one for oversized
+    batches, which the admission path has already chunked down to it).
+
+    Module-level on purpose: this IS the engine's executable-shape map, and
+    the trace-time recompile audit (analysis/trace_audit.py) imports it to
+    prove every admissible request shape lands on a precompiled bucket."""
+    for b in buckets:
+        if rows <= b:
+            return b
+    return buckets[-1]
+
+
 def instances_to_arrays(
     instances: list[dict],
 ) -> tuple[np.ndarray, np.ndarray]:
@@ -189,7 +215,7 @@ class MicroBatcher:
         fn: Callable,
         field_size: int,
         *,
-        buckets: Sequence[int] = (8, 32, 128, 512),
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
         max_wait_ms: float = 2.0,
         max_queue_rows: int | None = None,
         name: str = "predict",
@@ -260,7 +286,7 @@ class MicroBatcher:
         # oversized requests split into <= largest-bucket chunks up front,
         # so the worker never has to slice mid-item
         cap = self._buckets[-1]
-        starts = list(range(0, n, cap))
+        starts = list(admission_starts(n, cap))
         req = _Request(n, len(starts))
         with self._cond:
             if self._closed:
@@ -321,10 +347,7 @@ class MicroBatcher:
     # ---------------------------------------------------------------- worker
 
     def _pick_bucket(self, rows: int) -> int:
-        for b in self._buckets:
-            if rows <= b:
-                return b
-        return self._buckets[-1]
+        return pick_bucket(self._buckets, rows)
 
     def _run(self) -> None:
         while True:

@@ -107,7 +107,7 @@ def load_servable(directory: str | os.PathLike) -> tuple[Callable, Config]:
 def load_batching_servable(
     directory: str | os.PathLike,
     *,
-    buckets: tuple[int, ...] = (8, 32, 128, 512),
+    buckets: tuple[int, ...] | None = None,
     max_wait_ms: float = 2.0,
     max_queue_rows: int | None = None,
     precompile: bool = True,
@@ -121,11 +121,12 @@ def load_batching_servable(
     live request never pays a compile.  This is the embeddable form of
     what ``serve_forever`` runs behind HTTP.
     """
-    from .batcher import MicroBatcher
+    from .batcher import DEFAULT_BUCKETS, MicroBatcher
 
     predict, cfg = load_servable(directory)
     batcher = MicroBatcher(
-        predict, cfg.model.field_size, buckets=buckets,
+        predict, cfg.model.field_size,
+        buckets=DEFAULT_BUCKETS if buckets is None else buckets,
         max_wait_ms=max_wait_ms, max_queue_rows=max_queue_rows,
     )
     if precompile:

@@ -111,6 +111,28 @@ class SwappableParams:
             return True
 
 
+def build_predict_with(model, cfg: Config) -> Callable:
+    """The weight-parameterized jitted predict:
+    ``predict_with(payload, feat_ids, feat_vals) -> prob``.
+
+    Params ride as an ARGUMENT (not a closure constant), so the per-bucket
+    executables are shared across weight versions and a hot swap is a jit
+    cache hit.  Single source of truth: the servable loader below and the
+    trace-time audit (analysis/trace_audit.py, which lowers this function
+    with abstract payloads to prove the cache-hit/no-transfer contracts)
+    both build the jitted function HERE."""
+
+    @jax.jit
+    def predict_with(payload, feat_ids, feat_vals):
+        logits, _ = model.apply(
+            payload["params"], payload["model_state"],
+            feat_ids, feat_vals, cfg=cfg.model, train=False,
+        )
+        return jax.nn.sigmoid(logits)
+
+    return predict_with
+
+
 def load_swappable_servable(
     directory: str | os.PathLike,
 ) -> tuple[Callable, Callable, SwappableParams, Config]:
@@ -148,14 +170,7 @@ def load_swappable_servable(
         {"params": params, "model_state": model_state}, jax.devices()[0]
     )
     holder = SwappableParams(payload, version=0)
-
-    @jax.jit
-    def predict_with(payload, feat_ids, feat_vals):
-        logits, _ = model.apply(
-            payload["params"], payload["model_state"],
-            feat_ids, feat_vals, cfg=cfg.model, train=False,
-        )
-        return jax.nn.sigmoid(logits)
+    predict_with = build_predict_with(model, cfg)
 
     def predict(feat_ids, feat_vals):
         payload, gen = holder.acquire()
@@ -246,7 +261,8 @@ class HotSwapper:
         outright (``polls_skipped_total``): an outage costs one probe per
         cooldown, not a retry storm per tick, and old weights keep
         serving."""
-        self.last_check_unix = time.time()
+        with self._lock:
+            self.last_check_unix = time.time()
         if not self._breaker.allow():
             with self._lock:
                 self.polls_skipped_total += 1
@@ -283,8 +299,10 @@ class HotSwapper:
                 payload, version=manifest.version, manifest=manifest,
                 drain_timeout_secs=self._drain_timeout,
             )
-            self.last_swap_ms = round(1e3 * (time.perf_counter() - t0), 3)
             with self._lock:
+                self.last_swap_ms = round(
+                    1e3 * (time.perf_counter() - t0), 3
+                )
                 self.swaps_total += 1
                 self.last_error = (
                     None if drained else "drain timeout (swap still applied)"

@@ -270,6 +270,22 @@ def _make_lazy_train_step(cfg: Config, model, tx) -> Callable:
     return train_step
 
 
+def jitted_train_step(cfg: Config, *, donate: bool = True) -> Callable:
+    """The canonical single-device compiled step: ``jax.jit`` of
+    :func:`make_train_step` with the state argument DONATED, so parameter
+    and optimizer buffers update in place instead of paying a full copy
+    per step (the SPMD paths in ``parallel/`` already donate; this is the
+    same contract for every plain-jit consumer — online trainer, replay
+    oracle, benches).  The donation audit (analysis/trace_audit.py) lowers
+    this function and verifies the aliasing made it into the executable.
+
+    Donation contract for callers: the passed-in state is CONSUMED — rebind
+    (``state, metrics = step(state, batch)``) and never touch the old
+    reference again.  Every loop in this repo already follows that shape."""
+    return jax.jit(make_train_step(cfg),
+                   donate_argnums=(0,) if donate else ())
+
+
 def make_eval_step(cfg: Config, lookup_fn=None) -> Callable:
     """``(state, auc_state, batch) -> (auc_state, metrics)``: loss + streaming
     AUC accumulation (the reference's eval metric, ps:282)."""

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Static-analysis gate: ruff (mechanical, skips gracefully when absent —
+# scripts/lint.sh) + the JAX-aware analyzer (deepfm_tpu/analysis: AST rules
+# incl. the guarded-by race lint, plus the trace-time contract audit), both
+# ratcheted against analysis_baseline.json — new findings exit non-zero,
+# baselined debt does not.  Usage: scripts/check.sh [--json]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+scripts/lint.sh
+
+fmt="text"
+if [[ "${1:-}" == "--json" ]]; then
+    fmt="json"
+fi
+
+exec env JAX_PLATFORMS=cpu python -m deepfm_tpu.analysis deepfm_tpu \
+    --trace-audit --format "$fmt" --baseline analysis_baseline.json

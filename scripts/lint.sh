@@ -1,6 +1,7 @@
 #!/usr/bin/env bash
-# Mechanical style/correctness gate: ruff over deepfm_tpu/ + tests/
-# (config: ruff.toml at the repo root).  Usage: scripts/lint.sh [--fix]
+# Mechanical style/correctness gate: ruff over deepfm_tpu/ + tests/ +
+# benchmarks/ (config: ruff.toml at the repo root).
+# Usage: scripts/lint.sh [--fix]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -11,4 +12,4 @@ if ! command -v ruff >/dev/null 2>&1; then
     exit 0
 fi
 
-exec ruff check "$@" deepfm_tpu tests
+exec ruff check "$@" deepfm_tpu tests benchmarks

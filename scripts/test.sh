@@ -1,11 +1,12 @@
 #!/usr/bin/env bash
-# One-command gate: lint (scripts/lint.sh — skips gracefully when ruff is
-# absent) + the tier-1 test suite (ROADMAP.md's verify command, minus the
-# log plumbing).  Usage: scripts/test.sh [extra pytest args]
+# One-command gate: static analysis (scripts/check.sh — ruff when present
+# + the JAX-aware analyzer ratcheted against analysis_baseline.json) + the
+# tier-1 test suite (ROADMAP.md's verify command, minus the log plumbing).
+# Usage: scripts/test.sh [extra pytest args]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-scripts/lint.sh
+scripts/check.sh
 
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider "$@"

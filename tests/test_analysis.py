@@ -1,0 +1,944 @@
+"""Static-analysis suite tests (deepfm_tpu/analysis).
+
+Fixture snippets run the real engines against in-memory sources: every
+AST rule gets a positive (seeded violation caught) and a negative (clean
+idiom not flagged) case; the baseline ratchet, suppression syntax, and
+JSON output schema are covered; the trace-time audit is exercised both on
+the real entrypoints (must be clean — this IS the CI gate as a test) and
+against deliberately broken contracts (must trip).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deepfm_tpu.analysis import run_ast_engine
+from deepfm_tpu.analysis.baseline import (
+    load_baseline,
+    partition,
+    write_baseline,
+)
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+def analyze(src: str, path: str = "mod.py"):
+    return run_ast_engine({path: src})
+
+
+# ---------------------------------------------------------------- engine 1
+
+class TestTracerHostOp:
+    def test_item_inside_jit_caught(self):
+        f = analyze(
+            "import jax\n"
+            "@jax.jit\n"
+            "def step(x):\n"
+            "    return float(x.sum().item())\n"
+        )
+        assert "tracer-host-op" in rules_of(f)
+        assert any(".item()" in x.message for x in f)
+
+    def test_numpy_call_inside_jit_caught(self):
+        f = analyze(
+            "import jax\n"
+            "import numpy as np\n"
+            "@jax.jit\n"
+            "def step(x):\n"
+            "    return np.asarray(x) + 1\n"
+        )
+        assert "tracer-host-op" in rules_of(f)
+
+    def test_jit_reachable_via_factory_and_callee(self):
+        # jax.jit(make_step(cfg)) marks the factory's returned inner fn;
+        # the helper it calls by bare name is traced transitively
+        f = analyze(
+            "import jax\n"
+            "def helper(x):\n"
+            "    return x.tolist()\n"
+            "def make_step(cfg):\n"
+            "    def step(x):\n"
+            "        return helper(x)\n"
+            "    return step\n"
+            "fn = jax.jit(make_step(None))\n"
+        )
+        assert "tracer-host-op" in rules_of(f)
+
+    def test_static_shape_idiom_not_flagged(self):
+        # int(x.shape[0]) is a python int at trace time — trace-safe
+        f = analyze(
+            "import jax\n"
+            "@jax.jit\n"
+            "def step(x):\n"
+            "    b = int(x.shape[0])\n"
+            "    n = int(len(x))\n"
+            "    return x.reshape(b, -1), n\n"
+        )
+        assert "tracer-host-op" not in rules_of(f)
+
+    def test_partially_static_arg_still_flagged(self):
+        # .shape inside the expression must not exempt a traced sum
+        f = analyze(
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "@jax.jit\n"
+            "def step(x):\n"
+            "    return int(jnp.sum(x) / x.shape[0])\n"
+        )
+        assert "tracer-host-op" in rules_of(f)
+
+    def test_executor_map_is_not_a_transform(self):
+        # ThreadPoolExecutor.map must not mark the callback jit-reachable
+        f = analyze(
+            "from concurrent.futures import ThreadPoolExecutor\n"
+            "def fetch(u):\n"
+            "    return float(u.score)\n"
+            "def fan_out(ex, urls):\n"
+            "    return list(ex.map(fetch, urls))\n"
+        )
+        assert "tracer-host-op" not in rules_of(f)
+
+    def test_same_name_methods_all_analyzed(self):
+        # bare-name collisions must not skip the second def's body
+        f = analyze(
+            "import jax\n"
+            "class A:\n"
+            "    def sample(self, key, shape):\n"
+            "        return jax.random.normal(key, shape)\n"
+            "class B:\n"
+            "    def sample(self, key, shape):\n"
+            "        a = jax.random.normal(key, shape)\n"
+            "        b = jax.random.uniform(key, shape)\n"
+            "        return a + b\n"
+        )
+        assert "prng-reuse" in rules_of(f)
+
+    def test_host_side_float_not_flagged(self):
+        f = analyze(
+            "def configure(ms):\n"
+            "    return float(ms) / 1e3\n"
+        )
+        assert "tracer-host-op" not in rules_of(f)
+
+    def test_np_dtype_attribute_not_flagged(self):
+        f = analyze(
+            "import jax\n"
+            "import numpy as np\n"
+            "@jax.jit\n"
+            "def step(x):\n"
+            "    return x.astype(np.float32)\n"
+        )
+        assert f == []
+
+
+class TestTracedNondeterminism:
+    def test_wall_clock_in_jit_caught(self):
+        f = analyze(
+            "import jax, time\n"
+            "@jax.jit\n"
+            "def step(x):\n"
+            "    return x * time.time()\n"
+        )
+        assert "traced-nondeterminism" in rules_of(f)
+
+    def test_python_random_in_jit_caught(self):
+        f = analyze(
+            "import jax, random\n"
+            "@jax.jit\n"
+            "def step(x):\n"
+            "    return x + random.random()\n"
+        )
+        assert "traced-nondeterminism" in rules_of(f)
+
+    def test_jax_random_alias_not_nondeterminism(self):
+        # `from jax import random` draws are keyed and deterministic — only
+        # STDLIB random is trace-time nondeterminism
+        f = analyze(
+            "import jax\n"
+            "from jax import random\n"
+            "@jax.jit\n"
+            "def step(key, x):\n"
+            "    return x + random.normal(key, x.shape)\n"
+        )
+        assert "traced-nondeterminism" not in rules_of(f)
+
+    def test_np_random_in_jit_is_nondeterminism_not_host_op(self):
+        # the right fix is a jax key, not a jnp spelling — rule id matters
+        # for the suppression/baseline contract
+        f = analyze(
+            "import jax\n"
+            "import numpy as np\n"
+            "@jax.jit\n"
+            "def step(x):\n"
+            "    return x + np.random.normal(size=3)\n"
+        )
+        assert rules_of(f) == ["traced-nondeterminism"]
+
+    def test_wall_clock_outside_jit_ok(self):
+        f = analyze(
+            "import time\n"
+            "def poll(x):\n"
+            "    return time.time() - x\n"
+        )
+        assert f == []
+
+
+class TestPrngReuse:
+    def test_double_draw_caught(self):
+        f = analyze(
+            "import jax\n"
+            "def init(key):\n"
+            "    key = jax.random.PRNGKey(0)\n"
+            "    a = jax.random.normal(key, (3,))\n"
+            "    b = jax.random.normal(key, (3,))\n"
+            "    return a + b\n"
+        )
+        assert "prng-reuse" in rules_of(f)
+
+    def test_split_between_draws_ok(self):
+        f = analyze(
+            "import jax\n"
+            "def init(key):\n"
+            "    k1, k2 = jax.random.split(jax.random.PRNGKey(0))\n"
+            "    a = jax.random.normal(k1, (3,))\n"
+            "    b = jax.random.normal(k2, (3,))\n"
+            "    return a + b\n"
+        )
+        assert "prng-reuse" not in rules_of(f)
+
+    def test_parameter_key_double_draw_caught(self):
+        # the most common shape: a key RECEIVED by the function is fresh
+        # exactly once — two draws from it are correlated
+        f = analyze(
+            "import jax\n"
+            "def sample(key, shape):\n"
+            "    a = jax.random.normal(key, shape)\n"
+            "    b = jax.random.uniform(key, shape)\n"
+            "    return a + b\n"
+        )
+        assert "prng-reuse" in rules_of(f)
+
+    def test_parameter_key_single_draw_ok(self):
+        f = analyze(
+            "import jax\n"
+            "def sample(key, shape):\n"
+            "    return jax.random.normal(key, shape)\n"
+        )
+        assert "prng-reuse" not in rules_of(f)
+
+    def test_stdlib_random_not_a_key_draw(self):
+        # stdlib random shares the module name; two calls with a shared
+        # first-arg Name must not read as correlated key draws
+        f = analyze(
+            "import random\n"
+            "def jitter(lo, hi):\n"
+            "    a = random.uniform(lo, hi)\n"
+            "    b = random.uniform(lo, hi)\n"
+            "    return a + b\n"
+        )
+        assert "prng-reuse" not in rules_of(f)
+
+    def test_from_jax_import_random_alias_caught(self):
+        f = analyze(
+            "from jax import random\n"
+            "def sample(key, shape):\n"
+            "    a = random.normal(key, shape)\n"
+            "    b = random.uniform(key, shape)\n"
+            "    return a + b\n"
+        )
+        assert "prng-reuse" in rules_of(f)
+
+    def test_exclusive_branches_not_reuse(self):
+        # one draw per path: never more than one consumption at runtime
+        f = analyze(
+            "import jax\n"
+            "def sample(key, flag, shape):\n"
+            "    if flag:\n"
+            "        x = jax.random.normal(key, shape)\n"
+            "    else:\n"
+            "        x = jax.random.uniform(key, shape)\n"
+            "    return x\n"
+        )
+        assert "prng-reuse" not in rules_of(f)
+
+    def test_branch_then_second_draw_caught(self):
+        # both paths consume, so the draw AFTER the if is a real reuse
+        f = analyze(
+            "import jax\n"
+            "def sample(key, flag, shape):\n"
+            "    if flag:\n"
+            "        x = jax.random.normal(key, shape)\n"
+            "    else:\n"
+            "        x = jax.random.uniform(key, shape)\n"
+            "    return x + jax.random.normal(key, shape)\n"
+        )
+        assert "prng-reuse" in rules_of(f)
+
+    def test_rearm_via_split_subscript_ok(self):
+        # key = jax.random.split(key)[0] is a fresh subkey
+        f = analyze(
+            "import jax\n"
+            "def sample(key, shape):\n"
+            "    a = jax.random.normal(key, shape)\n"
+            "    key = jax.random.split(key)[0]\n"
+            "    b = jax.random.normal(key, shape)\n"
+            "    return a + b\n"
+        )
+        assert "prng-reuse" not in rules_of(f)
+
+    def test_loop_invariant_key_draw_caught(self):
+        # iteration 2 draws from the key iteration 1 consumed
+        f = analyze(
+            "import jax\n"
+            "def sample(key, n):\n"
+            "    out = []\n"
+            "    for _ in range(n):\n"
+            "        out.append(jax.random.normal(key, (3,)))\n"
+            "    return out\n"
+        )
+        assert "prng-reuse" in rules_of(f)
+        assert len([x for x in f if x.rule == "prng-reuse"]) == 1
+
+    def test_loop_with_fold_in_ok(self):
+        f = analyze(
+            "import jax\n"
+            "def sample(rng, n):\n"
+            "    out = []\n"
+            "    for i in range(n):\n"
+            "        key = jax.random.fold_in(rng, i)\n"
+            "        out.append(jax.random.normal(key, (3,)))\n"
+            "    return out\n"
+        )
+        assert "prng-reuse" not in rules_of(f)
+
+    def test_rearm_by_fold_in_ok(self):
+        f = analyze(
+            "import jax\n"
+            "def init(rng, step):\n"
+            "    key = jax.random.fold_in(rng, step)\n"
+            "    a = jax.random.normal(key, (3,))\n"
+            "    key = jax.random.fold_in(rng, step + 1)\n"
+            "    b = jax.random.normal(key, (3,))\n"
+            "    return a + b\n"
+        )
+        assert "prng-reuse" not in rules_of(f)
+
+
+class TestInt32Cast:
+    def test_arithmetic_result_caught(self):
+        f = analyze(
+            "import jax.numpy as jnp\n"
+            "def seg(ids, fields):\n"
+            "    return (ids * fields).astype(jnp.int32)\n"
+        )
+        assert "int32-cast" in rules_of(f)
+
+    def test_cast_before_clip_caught(self):
+        f = analyze(
+            "import jax.numpy as jnp\n"
+            "def narrow(ids, v):\n"
+            "    return jnp.clip(ids.astype(jnp.int32), 0, v - 1)\n"
+        )
+        assert "int32-cast" in rules_of(f)
+        assert any("AFTER" in x.message for x in f)
+
+    def test_clip_before_cast_ok(self):
+        f = analyze(
+            "import jax.numpy as jnp\n"
+            "def narrow(ids, v):\n"
+            "    return jnp.clip(ids, 0, v - 1).astype(jnp.int32)\n"
+        )
+        assert "int32-cast" not in rules_of(f)
+
+    def test_bounded_floordiv_ok(self):
+        f = analyze(
+            "import jax.numpy as jnp\n"
+            "def win(uids, per):\n"
+            "    return (uids // per).astype(jnp.int32)\n"
+        )
+        assert "int32-cast" not in rules_of(f)
+
+
+class TestSwallowedException:
+    def test_silent_pass_caught(self):
+        f = analyze(
+            "def poll(fn):\n"
+            "    try:\n"
+            "        fn()\n"
+            "    except Exception:\n"
+            "        pass\n"
+        )
+        assert "swallowed-exception" in rules_of(f)
+
+    def test_bare_except_caught(self):
+        f = analyze(
+            "def poll(fn):\n"
+            "    try:\n"
+            "        fn()\n"
+            "    except:\n"
+            "        return None\n"
+        )
+        assert "swallowed-exception" in rules_of(f)
+
+    def test_tuple_exception_type_caught(self):
+        f = analyze(
+            "def poll(fn):\n"
+            "    try:\n"
+            "        fn()\n"
+            "    except (Exception, SystemExit):\n"
+            "        pass\n"
+        )
+        assert "swallowed-exception" in rules_of(f)
+
+    def test_narrow_tuple_ok(self):
+        f = analyze(
+            "def poll(fn):\n"
+            "    try:\n"
+            "        fn()\n"
+            "    except (OSError, ValueError):\n"
+            "        pass\n"
+        )
+        assert "swallowed-exception" not in rules_of(f)
+
+    def test_reraise_ok(self):
+        f = analyze(
+            "def poll(fn, purge):\n"
+            "    try:\n"
+            "        fn()\n"
+            "    except Exception:\n"
+            "        purge()\n"
+            "        raise\n"
+        )
+        assert "swallowed-exception" not in rules_of(f)
+
+    def test_using_exception_ok(self):
+        f = analyze(
+            "def poll(fn, log):\n"
+            "    try:\n"
+            "        fn()\n"
+            "    except Exception as e:\n"
+            "        log.append(str(e))\n"
+        )
+        assert "swallowed-exception" not in rules_of(f)
+
+    def test_narrow_except_ok(self):
+        f = analyze(
+            "def poll(fn):\n"
+            "    try:\n"
+            "        fn()\n"
+            "    except OSError:\n"
+            "        pass\n"
+        )
+        assert "swallowed-exception" not in rules_of(f)
+
+
+GUARDED_CLASS = """
+import threading
+
+class Swapper:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.swaps = 0
+        self.last_ms = None
+
+    def status(self):
+        with self._lock:
+            return {"swaps": self.swaps, "last_ms": self.last_ms}
+
+    def poll(self, ms):
+        {MUTATION}
+        with self._lock:
+            self.swaps += 1
+"""
+
+
+class TestGuardedBy:
+    def test_unguarded_mutation_caught(self):
+        src = GUARDED_CLASS.replace("{MUTATION}", "self.last_ms = ms")
+        f = analyze(src)
+        assert "guarded-by" in rules_of(f)
+        assert any("last_ms" in x.message for x in f)
+
+    def test_guarded_mutation_ok(self):
+        src = GUARDED_CLASS.replace(
+            "{MUTATION}",
+            "with self._lock:\n            self.last_ms = ms"
+        )
+        assert "guarded-by" not in rules_of(analyze(src))
+
+    def test_init_exempt(self):
+        src = GUARDED_CLASS.replace("{MUTATION}", "pass")
+        # __init__ assigns swaps/last_ms lock-free: not flagged
+        assert "guarded-by" not in rules_of(analyze(src))
+
+    def test_container_mutation_caught(self):
+        f = analyze(
+            "import threading\n"
+            "class Q:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._items = []\n"
+            "    def drain(self):\n"
+            "        with self._lock:\n"
+            "            out, self._items = self._items, []\n"
+            "        return out\n"
+            "    def put(self, x):\n"
+            "        self._items.append(x)\n"
+        )
+        assert "guarded-by" in rules_of(f)
+
+    def test_tuple_unpack_mutation_caught(self):
+        # `self.a, self.b = ...` mutates both attributes
+        src = GUARDED_CLASS.replace(
+            "{MUTATION}", "self.last_ms, self.swaps = ms, 0"
+        )
+        f = analyze(src)
+        assert "guarded-by" in rules_of(f)
+        assert {m for x in f for m in ("last_ms", "swaps") if m in x.message} \
+            == {"last_ms", "swaps"}
+
+    def test_del_subscript_mutation_caught(self):
+        f = analyze(
+            "import threading\n"
+            "class M:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._m = {}\n"
+            "    def get(self, k):\n"
+            "        with self._lock:\n"
+            "            return self._m.get(k)\n"
+            "    def evict(self, k):\n"
+            "        del self._m[k]\n"
+        )
+        assert "guarded-by" in rules_of(f)
+
+    def test_lock_held_helper_fixpoint_ok(self):
+        # _trip is only ever called under the lock: its mutations count as
+        # held (the factored-out-critical-section idiom must not be noise)
+        f = analyze(
+            "import threading\n"
+            "class Breaker:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.opens = 0\n"
+            "    def record(self):\n"
+            "        with self._lock:\n"
+            "            self._trip()\n"
+            "    def _trip(self):\n"
+            "        self.opens += 1\n"
+        )
+        assert "guarded-by" not in rules_of(f)
+
+
+class TestSuppressions:
+    SRC = (
+        "def poll(fn):\n"
+        "    try:\n"
+        "        fn()\n"
+        "    # da:allow[swallowed-exception] probe: failure means fallback\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+
+    def test_justified_suppression_silences(self):
+        assert analyze(self.SRC) == []
+
+    def test_suppression_without_reason_is_a_finding(self):
+        src = self.SRC.replace(" probe: failure means fallback", "")
+        f = analyze(src)
+        assert rules_of(f) == ["suppression-missing-reason"]
+
+    def test_wrong_rule_id_does_not_silence(self):
+        src = self.SRC.replace("swallowed-exception", "guarded-by")
+        assert "swallowed-exception" in rules_of(analyze(src))
+
+    def test_unused_suppression_is_a_finding(self):
+        # the flagged code was fixed but the comment lingers: report it so
+        # it cannot silently swallow the NEXT finding on that line
+        f = analyze(
+            "def poll(fn):\n"
+            "    # da:allow[swallowed-exception] probe fallback\n"
+            "    return fn()\n"
+        )
+        assert rules_of(f) == ["unused-suppression"]
+
+    def test_docstring_syntax_example_not_a_suppression(self):
+        f = analyze(
+            '"""Docs: suppress with `# da:allow[rule-id] reason`."""\n'
+            "def f(x):\n"
+            "    return x\n"
+        )
+        assert f == []
+
+
+# ------------------------------------------------------------- baseline
+
+class TestBaselineRatchet:
+    SRC = (
+        "def poll(fn):\n"
+        "    try:\n"
+        "        fn()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+
+    def test_ratchet_accepts_then_tightens(self, tmp_path):
+        findings = analyze(self.SRC)
+        assert findings
+        path = str(tmp_path / "baseline.json")
+        write_baseline(path, findings)
+        baseline = load_baseline(path)
+        new, accepted, stale = partition(findings, baseline)
+        assert new == [] and len(accepted) == len(findings) and stale == []
+        # a second, NEW finding is not covered by the old baseline
+        worse = self.SRC + (
+            "def poll2(fn):\n"
+            "    try:\n"
+            "        fn()\n"
+            "    except BaseException:\n"
+            "        pass\n"
+        )
+        new, accepted, _ = partition(analyze(worse), baseline)
+        assert len(new) == 1 and len(accepted) == len(findings)
+
+    def test_fingerprints_survive_line_moves(self):
+        a = analyze(self.SRC)
+        b = analyze("import os\n\n\n" + self.SRC)  # shifted 3 lines down
+        assert [f.fingerprint for f in a] == [f.fingerprint for f in b]
+        assert a[0].line != b[0].line
+
+    def test_identical_findings_ratchet_by_count(self, tmp_path):
+        # fixing ONE of two byte-identical findings must not resurface the
+        # survivor as new (no occurrence renumbering)
+        two = (
+            "def a(fn):\n"
+            "    try:\n"
+            "        fn()\n"
+            "    except Exception:\n"
+            "        pass\n"
+            "def b(fn):\n"
+            "    try:\n"
+            "        fn()\n"
+            "    except Exception:\n"
+            "        pass\n"
+        )
+        findings = analyze(two)
+        assert len(findings) == 2
+        assert findings[0].fingerprint == findings[1].fingerprint
+        path = str(tmp_path / "b.json")
+        write_baseline(path, findings)
+        baseline = load_baseline(path)
+        # one fixed: survivor stays accepted, shrunk count reported stale
+        one = analyze(two.rsplit("def b", 1)[0])
+        new, accepted, stale = partition(one, baseline)
+        assert new == [] and len(accepted) == 1 and stale == [
+            findings[0].fingerprint
+        ]
+        # a THIRD identical occurrence exceeds the budget -> new
+        three = two + two.replace("def a", "def c").rsplit("def b", 1)[0]
+        new, accepted, _ = partition(analyze(three), baseline)
+        assert len(accepted) == 2 and len(new) == 1
+
+    def test_stale_entries_reported_not_fatal(self, tmp_path):
+        findings = analyze(self.SRC)
+        path = str(tmp_path / "baseline.json")
+        write_baseline(path, findings)
+        new, accepted, stale = partition([], load_baseline(path))
+        assert new == [] and accepted == [] and len(stale) == len(findings)
+
+
+# ------------------------------------------------------------- CLI / JSON
+
+class TestCli:
+    def _run(self, tmp_path, src, *args):
+        mod = tmp_path / "mod.py"
+        mod.write_text(src)
+        return subprocess.run(
+            [sys.executable, "-m", "deepfm_tpu.analysis", str(mod), *args],
+            capture_output=True, text=True, cwd=REPO,
+        )
+
+    def test_json_schema_and_exit_codes(self, tmp_path):
+        proc = self._run(
+            tmp_path,
+            "def f(fn):\n"
+            "    try:\n"
+            "        fn()\n"
+            "    except Exception:\n"
+            "        pass\n",
+            "--format", "json",
+        )
+        assert proc.returncode == 1, proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["schema"] == 1
+        assert doc["counts"]["new"] == len(doc["new"]) == 1
+        rec = doc["new"][0]
+        for key in ("rule", "path", "line", "col", "message", "hint",
+                    "fingerprint", "source"):
+            assert key in rec
+        assert rec["rule"] == "swallowed-exception"
+
+    def test_clean_file_exits_zero(self, tmp_path):
+        proc = self._run(tmp_path, "def f(x):\n    return x + 1\n")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_syntax_error_exits_two_not_one(self, tmp_path):
+        # a broken analyzer input must never read as "new findings"
+        proc = self._run(tmp_path, "def f(:\n")
+        assert proc.returncode == 2, (proc.returncode, proc.stderr)
+        assert "syntax error" in proc.stderr
+
+    def test_fingerprints_stable_across_invoking_cwd(self, tmp_path):
+        # the checked-in baseline must hold from any working directory:
+        # paths anchor to the repo root (.git), not os.getcwd()
+        proc = subprocess.run(
+            [sys.executable, "-m", "deepfm_tpu.analysis",
+             os.path.join(REPO, "deepfm_tpu"),
+             "--baseline", os.path.join(REPO, "analysis_baseline.json")],
+            capture_output=True, text=True, cwd=str(tmp_path),
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_write_baseline_subset_merges_not_truncates(self, tmp_path):
+        # rewriting the baseline from a subset run must keep other files'
+        # accepted debt
+        repo = tmp_path / "scratch"
+        (repo / ".git").mkdir(parents=True)
+        bad = (
+            "def f(fn):\n"
+            "    try:\n"
+            "        fn()\n"
+            "    except Exception:\n"
+            "        pass\n"
+        )
+        (repo / "a.py").write_text(bad)
+        (repo / "b.py").write_text(bad.replace("def f", "def g"))
+        env = {**os.environ, "PYTHONPATH": REPO}
+
+        def run(*argv):
+            return subprocess.run(
+                [sys.executable, "-m", "deepfm_tpu.analysis", *argv],
+                capture_output=True, text=True, cwd=str(repo), env=env,
+            )
+
+        assert run(str(repo), "--write-baseline").returncode == 0
+        # subset re-write over a.py only: b.py's debt must survive
+        assert run(str(repo / "a.py"), "--write-baseline").returncode == 0
+        proc = run(str(repo))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_default_baseline_resolves_against_repo_root(self, tmp_path):
+        # a scratch repo with accepted debt must gate green from ANY cwd
+        # without --baseline (default resolves against the .git root the
+        # finding paths anchor to, not the invoker's cwd)
+        repo = tmp_path / "scratch"
+        (repo / ".git").mkdir(parents=True)
+        (repo / "mod.py").write_text(
+            "def f(fn):\n"
+            "    try:\n"
+            "        fn()\n"
+            "    except Exception:\n"
+            "        pass\n"
+        )
+        env = {**os.environ, "PYTHONPATH": REPO}
+        proc = subprocess.run(
+            [sys.executable, "-m", "deepfm_tpu.analysis",
+             str(repo / "mod.py"), "--write-baseline"],
+            capture_output=True, text=True, cwd=str(tmp_path), env=env,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert (repo / "analysis_baseline.json").exists()  # at the ROOT
+        elsewhere = tmp_path / "elsewhere"
+        elsewhere.mkdir()
+        proc = subprocess.run(
+            [sys.executable, "-m", "deepfm_tpu.analysis",
+             str(repo / "mod.py")],
+            capture_output=True, text=True, cwd=str(elsewhere), env=env,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_trace_audit_crash_exits_two(self, tmp_path, monkeypatch):
+        # a crashing audit is an analyzer failure, not "new findings"
+        import deepfm_tpu.analysis.trace_audit as ta
+        from deepfm_tpu.analysis import cli as cli_mod
+
+        def boom():
+            raise RuntimeError("broken jax install")
+
+        monkeypatch.setattr(ta, "run_trace_audit", boom)
+        mod = tmp_path / "clean.py"
+        mod.write_text("def f(x):\n    return x\n")
+        assert cli_mod.main([str(mod), "--trace-audit"]) == 2
+
+    def test_corrupt_baseline_exits_two_not_one(self, tmp_path):
+        bad = tmp_path / "b.json"
+        bad.write_text("<<<<<<< merge conflict\n")
+        proc = self._run(tmp_path, "def f(x):\n    return x\n",
+                         "--baseline", str(bad))
+        assert proc.returncode == 2, (proc.returncode, proc.stderr)
+        assert "baseline" in proc.stderr
+
+    def test_write_baseline_then_green(self, tmp_path):
+        src = (
+            "def f(fn):\n"
+            "    try:\n"
+            "        fn()\n"
+            "    except Exception:\n"
+            "        pass\n"
+        )
+        base = tmp_path / "b.json"
+        proc = self._run(tmp_path, src, "--write-baseline",
+                         "--baseline", str(base))
+        assert proc.returncode == 0
+        proc = self._run(tmp_path, src, "--baseline", str(base))
+        assert proc.returncode == 0, proc.stdout
+
+
+# --------------------------------------------------- the repo gate itself
+
+class TestRepoIsClean:
+    """The analyzer over the real package IS a tier-1 test: a regression
+    that reintroduces a flagged idiom fails pytest, not just CI."""
+
+    def test_package_has_no_unbaselined_findings(self):
+        import os
+
+        files = {}
+        for dirpath, dirnames, names in os.walk(os.path.join(REPO, "deepfm_tpu")):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for n in names:
+                if n.endswith(".py"):
+                    full = os.path.join(dirpath, n)
+                    rel = os.path.relpath(full, REPO).replace(os.sep, "/")
+                    with open(full, encoding="utf-8") as f:
+                        files[rel] = f.read()
+        findings = run_ast_engine(files)
+        baseline = load_baseline(os.path.join(REPO, "analysis_baseline.json"))
+        new, _accepted, _stale = partition(findings, baseline)
+        assert new == [], "\n".join(f.render() for f in new)
+
+
+# ---------------------------------------------------------------- engine 2
+
+class TestTraceAudit:
+    def test_real_entrypoints_hold_all_contracts(self):
+        from deepfm_tpu.analysis.trace_audit import run_trace_audit
+
+        findings = run_trace_audit()
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_off_bucket_shape_caught(self, monkeypatch):
+        import deepfm_tpu.serve.batcher as batcher
+        from deepfm_tpu.analysis import trace_audit
+
+        monkeypatch.setattr(batcher, "pick_bucket",
+                            lambda buckets, rows: 7)  # never a bucket
+        findings = trace_audit.audit_buckets()
+        assert findings and findings[0].rule == "trace-recompile"
+        assert "precompiled bucket" in findings[0].message
+
+    def test_bucket_coverage_holds_for_any_sorted_set(self):
+        from deepfm_tpu.analysis.trace_audit import audit_buckets
+
+        assert audit_buckets(buckets=(8, 32)) == []
+        assert audit_buckets(buckets=(16,)) == []
+
+    def test_trace_findings_fingerprint_per_contract(self):
+        # two different defects, same rule+path, must not share a
+        # fingerprint (a baselined one could mask the other)
+        from deepfm_tpu.analysis.findings import fingerprint_findings
+        from deepfm_tpu.analysis.trace_audit import _finding
+
+        a = _finding("trace-dtype", "msg A", where="deepfm_tpu/x.py",
+                     slug="predict-f64")
+        b = _finding("trace-dtype", "msg B", where="deepfm_tpu/x.py",
+                     slug="predict-out-dtype")
+        fingerprint_findings([a, b])
+        assert a.fingerprint != b.fingerprint
+
+    def test_audit_probes_the_engines_real_defaults(self):
+        # imported, not copied: a serving-default change re-points the audit
+        from deepfm_tpu.analysis.trace_audit import _default_buckets
+        from deepfm_tpu.serve.batcher import DEFAULT_BUCKETS
+
+        assert _default_buckets() is DEFAULT_BUCKETS
+
+    def test_undonated_train_step_caught(self, monkeypatch):
+        import jax
+
+        import deepfm_tpu.train.step as step_mod
+        from deepfm_tpu.analysis import trace_audit
+
+        # swap the canonical constructor for an undonated jit and re-audit
+        monkeypatch.setattr(
+            step_mod, "jitted_train_step",
+            lambda cfg, **kw: jax.jit(step_mod.make_train_step(cfg)),
+        )
+        findings = trace_audit.audit_train_step()
+        assert any(f.rule == "trace-donation" for f in findings), \
+            "\n".join(f.render() for f in findings)
+
+    def test_constant_baked_params_caught(self):
+        """load_servable-style closure predict (params as constants) must
+        fail the weights-are-arguments check."""
+        import jax
+
+        from deepfm_tpu.analysis.trace_audit import (
+            _abstract_payload,
+            _audit_cfg,
+        )
+
+        cfg = _audit_cfg()
+        model, payload = _abstract_payload(cfg)
+        n_leaves = len(jax.tree_util.tree_leaves(payload))
+
+        @jax.jit
+        def predict_closed(feat_ids, feat_vals):
+            # params closed over -> lowered signature has only 2 inputs
+            return feat_ids.sum() + feat_vals.sum()
+
+        lo = predict_closed.lower(
+            jax.ShapeDtypeStruct((8, cfg.model.field_size), jax.numpy.int64),
+            jax.ShapeDtypeStruct((8, cfg.model.field_size), jax.numpy.float32),
+        )
+        n_in = len(jax.tree_util.tree_leaves(lo.in_avals))
+        assert n_in != n_leaves + 2  # the audit's discriminator fires
+
+
+class TestSeededViolationsEndToEnd:
+    """The acceptance trio: a tracer .item() inside jit, an unguarded
+    mutation of a locked attribute, and an off-bucket request shape are
+    each caught by the suite."""
+
+    def test_trio(self, monkeypatch):
+        item_src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def predict(x):\n"
+            "    return x.sum().item()\n"
+        )
+        race_src = GUARDED_CLASS.replace("{MUTATION}", "self.last_ms = ms")
+        assert "tracer-host-op" in rules_of(analyze(item_src))
+        assert "guarded-by" in rules_of(analyze(race_src))
+
+        import deepfm_tpu.serve.batcher as batcher
+        from deepfm_tpu.analysis import trace_audit
+
+        monkeypatch.setattr(batcher, "pick_bucket",
+                            lambda buckets, rows: rows)  # raw shape leaks
+        findings = trace_audit.audit_buckets(buckets=(8, 32, 128, 512))
+        assert any(f.rule == "trace-recompile" for f in findings)
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))
