@@ -149,6 +149,67 @@ def dense_adam_roofline(platform: str, device_kind: str = "") -> dict:
     return roof
 
 
+def spmd_ici_estimate(dp: int = 2, mp: int = 4) -> dict:
+    """Per-step ICI bytes for the sharded step's embedding collectives —
+    psum vs alltoall (ModelConfig.shard_exchange) — from B/F/K/M plus the
+    MEASURED dedup rate of the shared synthetic Criteo batch, so the
+    BENCH/MULTICHIP artifacts carry the comms math, not just HBM bytes.
+
+    psum: ring all-reduce of the dense local [B/dp, F(, K)] row tensor per
+    table, forward and backward -> 2 * 2(M-1)/M * S bytes each.
+    alltoall: request ids [M, C] one way, response rows [M, C, K] forward
+    and summed per-unique-row grads backward -> (M-1)/M of each buffer; C
+    is the static per-destination capacity (auto = ceil(N/M)), so the
+    traffic scales with the batch's deduped rows, not its dense volume.
+    """
+    from deepfm_tpu.parallel.embedding import exchange_capacity
+
+    import _bench_util as bu
+
+    b_local = BATCH // dp
+    n = b_local * F
+    host = bu.make_host_ctr_batches(BATCH, 1, v=V)[0]
+    ids = np.asarray(host["feat_ids"]).reshape(dp, -1)
+    per_shard_unique = [np.unique(s).size for s in ids]
+    dedup_rate = round(float(np.mean(per_shard_unique)) / n, 4)
+    cap_auto = exchange_capacity(n, mp, 0.0)
+    # capacity sized to the measured dedup (what the flagship bench uses;
+    # benchmarks/multichip_flagship.py A2A_CAPACITY) — the worst owner
+    # bucket of the unpermuted Criteo shape needs ~dedup_rate * N slots
+    cap_meas = exchange_capacity(n, mp, min(1.0, dedup_rate * 1.3))
+    ring = 2.0 * (mp - 1) / mp
+    wire = float(mp - 1) / mp
+
+    def psum_bytes():
+        s_v, s_w = n * K * 4, n * 4
+        return int(2 * ring * (s_v + s_w))  # fwd + bwd, both tables
+
+    def a2a_bytes(cap):
+        per_table_req = wire * mp * cap * 4
+        resp_v = wire * mp * cap * K * 4
+        resp_w = wire * mp * cap * 1 * 4
+        return int(2 * per_table_req + 2 * resp_v + 2 * resp_w)
+
+    out = {
+        "mesh": [dp, mp], "batch_local": b_local, "fields": F, "k": K,
+        "dedup_unique_fraction": dedup_rate,
+        "psum_bytes_per_step_est": psum_bytes(),
+        "alltoall_bytes_per_step_est": a2a_bytes(cap_auto),
+        "alltoall_bytes_per_step_est_capacity_measured": a2a_bytes(cap_meas),
+        "capacity_auto_rows": cap_auto,
+        "capacity_measured_rows": cap_meas,
+    }
+    out["alltoall_over_psum"] = round(
+        out["alltoall_bytes_per_step_est"] / out["psum_bytes_per_step_est"],
+        3,
+    )
+    out["alltoall_over_psum_capacity_measured"] = round(
+        out["alltoall_bytes_per_step_est_capacity_measured"]
+        / out["psum_bytes_per_step_est"], 3,
+    )
+    return out
+
+
 def _flagship_cfg(fused: str = "off", lazy: bool = False,
                   table_grad: str = "scatter"):
     from deepfm_tpu.core.config import Config
@@ -377,6 +438,12 @@ def main() -> None:
         "timing_method": "fetch",
     }
     roof = dense_adam_roofline(platform, _device_kind(platform))
+    # comms math for the SPMD variants: what a [2,4] flagship mesh moves
+    # over ICI per step, psum vs the deduplicated alltoall exchange
+    try:
+        roof["ici_bytes_per_step_est"] = spmd_ici_estimate()
+    except Exception as e:  # estimate-only: never sink the measurement
+        roof["ici_bytes_per_step_est"] = {"error": f"{type(e).__name__}: {e}"}
     xla_rate = rates.get("xla", (0.0, 0.0))[0]
     if xla_rate:
         meas_us = 1e6 * batch_size / xla_rate

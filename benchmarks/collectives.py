@@ -117,6 +117,20 @@ def bench_collectives(mesh: Mesh, size_mb: float, iters: int) -> list[dict]:
             local * 4,
             2 * elems * 4,
         ),
+        # the deduplicated shard-exchange's building block (parallel/
+        # embedding.py shard_exchange='alltoall'): each device keeps 1/n of
+        # its payload and sends (n-1)/n — measured here so the exchange's
+        # request/response legs have a cost curve per payload size
+        "all_to_all": (
+            shard_map(
+                lambda a: lax.all_to_all(
+                    a.reshape(n, -1), "data", 0, 0, tiled=True
+                ).reshape(a.shape),
+                mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+            ),
+            (n - 1) / n * local * 4,
+            2 * elems * 4,
+        ),
     }
     for name, (fn, bytes_moved, bytes_copied) in cases.items():
         jfn = jax.jit(fn)
@@ -131,31 +145,47 @@ def bench_collectives(mesh: Mesh, size_mb: float, iters: int) -> list[dict]:
     return results
 
 
-def bench_sharded_lookup(mesh: Mesh, iters: int) -> dict:
-    """The framework's own hot collective: row-sharded gather + psum."""
+def bench_sharded_lookup(mesh: Mesh, iters: int) -> list[dict]:
+    """The framework's own hot collective, in BOTH exchange modes: dense
+    zeros-plus-psum row assembly vs the deduplicated owned-rows-only
+    all_to_all exchange (parallel/embedding.py shard_exchange).  Zipf-
+    skewed ids (the Criteo shape) so the exchange's dedup actually bites;
+    the measured unique fraction rides along in each row."""
     from deepfm_tpu.parallel.embedding import sharded_lookup
 
     n = mesh.devices.size
+    # NOTE v=131k at this replicated-id size (40k ids, 18+16 bits) exceeds
+    # the uint32 packed-sort budget, so this row measures the exchange with
+    # the general variadic argsort — the worst case; the flagship train
+    # shape (V=117,581, 20k ids/shard) packs (benchmarks/multichip_flagship)
     v, k, b, f = 131_072, 32, 1024, 39
     table = jax.device_put(
         np.random.default_rng(0).normal(size=(v, k)).astype(np.float32),
         NamedSharding(mesh, P("model")),
     )
-    ids = jax.device_put(
-        np.random.default_rng(1).integers(0, v, size=(b, f)).astype(np.int32),
-        NamedSharding(mesh, P()),
-    )
-    fn = jax.jit(shard_map(
-        lambda t, i: sharded_lookup(t, i, axis_name="model"),
-        mesh=mesh, in_specs=(P("model"), P()), out_specs=P(),
-    ))
-    dt, dt_raw = _time(fn, table, ids, iters=iters)
-    return {
-        "collective": "sharded_embedding_lookup", "devices": n,
-        "rows": b * f, "k": k, "ms": round(dt * 1e3, 4),
-        "ms_uncorrected": round(dt_raw * 1e3, 4),
-        "lookups_per_sec": round(b * f / dt, 1),
-    }
+    host_ids = (
+        np.random.default_rng(1).zipf(1.3, size=(b, f)) % v
+    ).astype(np.int32)
+    ids = jax.device_put(host_ids, NamedSharding(mesh, P()))
+    dedup = round(float(np.unique(host_ids).size / host_ids.size), 4)
+    rows = []
+    for mode in ("psum", "alltoall"):
+        fn = jax.jit(shard_map(
+            lambda t, i, m=mode: sharded_lookup(
+                t, i, axis_name="model", exchange=m
+            ),
+            mesh=mesh, in_specs=(P("model"), P()), out_specs=P(),
+            check_vma=False,  # the exchange's cond defeats replication inference
+        ))
+        dt, dt_raw = _time(fn, table, ids, iters=iters)
+        rows.append({
+            "collective": f"sharded_embedding_lookup[{mode}]", "devices": n,
+            "rows": b * f, "k": k, "unique_fraction": dedup,
+            "ms": round(dt * 1e3, 4),
+            "ms_uncorrected": round(dt_raw * 1e3, 4),
+            "lookups_per_sec": round(b * f / dt, 1),
+        })
+    return rows
 
 
 def bench_lazy_composite(iters: int) -> dict | None:
@@ -264,9 +294,9 @@ def main() -> int:
                 rows.append(row)
                 print(json.dumps(row))
     with Mesh(devices.reshape(-1), ("model",)) as mesh:
-        row = bench_sharded_lookup(mesh, args.iters)
-        rows.append(row)
-        print(json.dumps(row))
+        for row in bench_sharded_lookup(mesh, args.iters):
+            rows.append(row)
+            print(json.dumps(row))
     comp = bench_lazy_composite(args.iters)
     if comp is not None:
         rows.append(comp)

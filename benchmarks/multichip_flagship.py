@@ -36,13 +36,24 @@ DEEP = (128, 64, 32)
 BATCH = 1024
 
 
-def _cfg(dp: int, mp: int, lazy: bool):
+# per-destination request capacity for the *_a2a variants: the flagship
+# batch's unique fraction is ~0.12 of B_local*F and (unpermuted) Criteo-
+# shaped ids crowd shard 0, so 0.15 covers the worst owner bucket with
+# slack while keeping the exchange buffers ~2.5x smaller than the auto
+# N/M capacity (see bench.py spmd_ici_estimate for the byte math)
+A2A_CAPACITY = 0.15
+
+
+def _cfg(dp: int, mp: int, lazy: bool, exchange: str = "psum"):
     from deepfm_tpu.core.config import Config
 
     return Config.from_dict({
         "model": {
             "feature_size": V, "field_size": F, "embedding_size": K,
             "deep_layers": DEEP, "dropout_keep": (0.5, 0.5, 0.5),
+            "shard_exchange": exchange,
+            "shard_exchange_capacity":
+                A2A_CAPACITY if exchange == "alltoall" else 0.0,
         },
         "optimizer": {"learning_rate": 0.0005,
                       "lazy_embedding_updates": lazy},
@@ -61,9 +72,11 @@ def measure(dp: int, mp: int, variant: str, dispatches: int) -> dict:
         make_spmd_train_step, shard_batch, shard_batch_stacked,
     )
 
-    lazy = variant == "lazy"
-    k = int(variant.rsplit("scan", 1)[1]) if "scan" in variant else 1
-    cfg = _cfg(dp, mp, lazy)
+    base, _, suffix = variant.partition("@")
+    exchange = suffix or "psum"
+    lazy = base == "lazy"
+    k = int(base.rsplit("scan", 1)[1]) if "scan" in base else 1
+    cfg = _cfg(dp, mp, lazy, exchange)
     mesh = build_mesh(MeshConfig(data_parallel=dp, model_parallel=mp))
     ctx = make_context(cfg, mesh)
     state = create_spmd_state(ctx)
@@ -100,6 +113,8 @@ def measure(dp: int, mp: int, variant: str, dispatches: int) -> dict:
     dt = time.perf_counter() - t0
     return {
         "mesh": [dp, mp], "variant": variant,
+        "shard_exchange": exchange,
+        "shard_exchange_capacity": cfg.model.shard_exchange_capacity,
         "examples_per_sec": round(dispatches * BATCH * k / dt, 1),
         "step_ms": round(dt / (dispatches * k) * 1e3, 3),
         "final_loss": round(
@@ -133,7 +148,14 @@ def main() -> None:
 
     rows = []
     for dp, mp in ((2, 4), (4, 2), (8, 1)):
-        for variant in ("dense", "lazy", "scan8"):
+        # psum vs alltoall at the SAME model/data/mesh config wherever the
+        # model axis actually shards rows (mp > 1); a singleton model axis
+        # has no row exchange to deduplicate
+        variants = (
+            ("dense", "dense@alltoall", "lazy", "lazy@alltoall", "scan8")
+            if mp > 1 else ("dense", "scan8")
+        )
+        for variant in variants:
             env = dict(os.environ)
             env["JAX_PLATFORMS"] = "cpu"
             env["XLA_FLAGS"] = (
@@ -171,7 +193,13 @@ def main() -> None:
             "8 virtual CPU devices on one host: validates the full GSPMD "
             "program (row-sharded tables + batch sharding + collectives) at "
             "flagship vocab and shows RELATIVE mesh/variant costs; absolute "
-            "rates are not a hardware perf claim (see BENCH_TPU.json)"
+            "rates are not a hardware perf claim (see BENCH_TPU.json). "
+            "shard_exchange pairs share the mesh/model/data config: on this "
+            "shared-memory mesh the DENSE pair favors psum (its assembly is "
+            "a memcpy; alltoall's wire win needs a wire) while the LAZY "
+            "pair favors alltoall (the dedup sort is shared with the update "
+            "machinery it shrinks) — docs/ARCHITECTURE.md 'Sharded "
+            "embeddings' has the traffic table and measurements"
         ),
         "rows": rows,
     }

@@ -147,6 +147,18 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     if args.trace_audit:
+        # the SPMD collective contract lowers on an 8-device virtual mesh;
+        # arrange the devices BEFORE jax initializes.  The flag only sizes
+        # the HOST (cpu) platform, so it is harmless when JAX_PLATFORMS is
+        # unset or points elsewhere; if something already imported jax the
+        # audit reports the skipped contract on an insufficient topology.
+        if (os.environ.get("JAX_PLATFORMS", "cpu") in ("", "cpu")
+                and "jax" not in sys.modules):
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
         try:
             from .trace_audit import run_trace_audit
 

@@ -78,6 +78,12 @@ RULES = {
         "silent dtype promotion: float64 (or an unexpected widening) in a "
         "jitted entrypoint's signature"
     ),
+    "trace-collective": (
+        "sharded train step violates its collective-traffic contract: a "
+        "dense row-tensor all-reduce/all-gather outside the capacity-"
+        "overflow fallback in alltoall mode, or a blind detector in psum "
+        "mode (parallel/embedding.py shard_exchange)"
+    ),
 }
 
 

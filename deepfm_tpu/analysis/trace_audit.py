@@ -32,6 +32,18 @@ not a benchmark:
   silent x64 upgrade doubles bytes and halves serving throughput before
   any test notices) and the predict output is exactly float32 (no
   surprise bf16 widening of the wire format).
+* **collective-traffic audit** — lower the REAL sharded train step on the
+  8-device virtual mesh in each ``shard_exchange`` mode and hold the
+  lowering to its traffic contract: in ``alltoall`` mode the program must
+  contain NO all-reduce/all-gather whose operand is the full dense
+  ``[B_local, F, K]`` row tensor outside the capacity-overflow fallback
+  branches (``stablehlo.case`` regions — the fallback is allowed to be
+  dense, the main line is not), and must actually carry the
+  ``all_to_all`` pair; in ``psum`` mode the dense all-reduce must be
+  PRESENT (the detector's self-check — if lowering drifts so the scanner
+  goes blind, psum mode fails loudly instead of alltoall passing
+  vacuously).  The per-mode expected sets live in
+  :data:`EXCHANGE_CONTRACT`.
 
 Failures are reported as the same :class:`~.findings.Finding` records as
 engine 1 (rules ``trace-transfer`` / ``trace-recompile`` /
@@ -330,6 +342,252 @@ def audit_train_step(cfg=None) -> list[Finding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# collective-traffic contract (sharded-lookup exchange, parallel/embedding.py)
+
+_COLLECTIVE_OPS = (
+    "all_reduce", "all_gather", "all_to_all", "reduce_scatter",
+    "collective_permute",
+)
+
+# per-mode expected collective sets for the sharded train step — the
+# contract the audit enforces, recorded here as data so tests/docs and the
+# finding messages share one source of truth
+EXCHANGE_CONTRACT = {
+    "psum": {
+        "requires": "all_reduce over the dense [B_local, F(, K)] row "
+                    "tensor (zeros-plus-psum assembly, fwd+bwd)",
+        "forbids": None,
+    },
+    "alltoall": {
+        "requires": "all_to_all request/response pair outside any "
+                    "conditional region",
+        "forbids": "all_reduce/all_gather of the dense [B_local, F(, K)] "
+                   "row tensor outside stablehlo.case (the capacity-"
+                   "overflow fallback branches)",
+    },
+    "alltoall_lazy": {
+        "requires": "all_to_all forward exchange; all_gather only of the "
+                    "capacity-bounded unique pack",
+        "forbids": "all_gather of the full [B_local*F, K] occurrence-grad "
+                   "stream outside stablehlo.case",
+    },
+}
+
+
+def _tensor_shapes(line: str) -> list[tuple[int, ...]]:
+    """Operand shapes from an op's `: (tensor<AxBxDT>, ...) ->` signature."""
+    import re
+
+    m = re.search(r":\s*\(([^)]*)\)\s*->", line)
+    if not m:
+        return []
+    shapes = []
+    for dims in re.findall(r"tensor<([0-9]+(?:x[0-9]+)*)x?[a-z]", m.group(1)):
+        shapes.append(tuple(int(d) for d in dims.split("x")))
+    return shapes
+
+
+def summarize_collectives(mlir_text: str) -> list[dict]:
+    """Scan lowered StableHLO text for collective ops: kind, operand
+    shapes, and WHICH conditional branch (if any) each op sits in.
+
+    ``branch`` is ``None`` for the unconditional main line, else the
+    ``(cond_id, branch_index)`` of the innermost ``stablehlo.case``/``if``
+    region — the lax.cond capacity-overflow structure, whose exchange and
+    dense-fallback arms the contract must tell apart.  Region-carrying ops
+    (all_reduce) print their type signature on the region's closing line;
+    the scanner tracks brace depth to pick it up, to advance branch
+    indices at ``}, {`` separators, and to know when a region ends."""
+    out: list[dict] = []
+    depth = 0
+    cond_id = 0
+    # stack of [open_depth, cond_id, branch_index]
+    cond_stack: list[list[int]] = []
+    pending: tuple[dict, int] | None = None
+    for line in mlir_text.splitlines():
+        if cond_stack and line.strip() == "}, {" \
+                and depth == cond_stack[-1][0] + 1:
+            cond_stack[-1][2] += 1
+        if "stablehlo.case" in line or "stablehlo.if" in line:
+            cond_id += 1
+            cond_stack.append([depth, cond_id, 0])
+        kind = next(
+            (k for k in _COLLECTIVE_OPS if f"stablehlo.{k}" in line), None
+        )
+        if kind is not None:
+            entry = {
+                "op": kind,
+                "shapes": _tensor_shapes(line),
+                "branch": (
+                    (cond_stack[-1][1], cond_stack[-1][2])
+                    if cond_stack else None
+                ),
+            }
+            out.append(entry)
+            if not entry["shapes"]:
+                pending = (entry, depth)
+        depth += line.count("{") - line.count("}")
+        if pending is not None and depth <= pending[1]:
+            if not pending[0]["shapes"]:
+                pending[0]["shapes"] = _tensor_shapes(line)
+            pending = None
+        while cond_stack and depth <= cond_stack[-1][0]:
+            cond_stack.pop()
+    return out
+
+
+def check_exchange_collectives(
+    mlir_text: str,
+    dense_shapes: set[tuple[int, ...]],
+    *,
+    mode: str,
+    variant: str = "dense",
+    where: str = "deepfm_tpu/parallel/embedding.py",
+) -> list[Finding]:
+    """Hold one lowered train step to the per-mode collective contract
+    (:data:`EXCHANGE_CONTRACT`).  Factored out of :func:`audit_spmd_exchange`
+    so the seeded-violation test can feed a psum-mode lowering through the
+    alltoall contract and watch it get caught."""
+    cols = summarize_collectives(mlir_text)
+    seen = sorted({
+        (c["op"], "main" if c["branch"] is None else "cond") for c in cols
+    })
+
+    def is_dense(c):
+        return (c["op"] in ("all_reduce", "all_gather")
+                and any(s in dense_shapes for s in c["shapes"]))
+
+    out: list[Finding] = []
+    if mode == "psum":
+        if not any(is_dense(c) for c in cols):
+            out.append(_finding(
+                "trace-collective",
+                f"psum-mode train step lowering shows NO dense row-tensor "
+                f"all-reduce/all-gather (expected {sorted(dense_shapes)}) "
+                f"— the collective detector or the lowering drifted; "
+                f"observed collectives: {seen}",
+                hint="update the audit's shape derivation or the scanner "
+                     "(summarize_collectives)",
+                where=where, slug=f"{variant}-psum-detector-blind",
+            ))
+        return out
+    # alltoall contract: the main line may never move the dense row
+    # tensor; inside each lax.cond, dense collectives may live only in
+    # the fallback arm — never alongside the all_to_all exchange
+    contract = EXCHANGE_CONTRACT[
+        "alltoall_lazy" if variant == "lazy" else "alltoall"
+    ]
+    main_dense = [c for c in cols if is_dense(c) and c["branch"] is None]
+    if main_dense:
+        out.append(_finding(
+            "trace-collective",
+            f"{variant} train step in shard_exchange='alltoall' still "
+            f"moves the dense row tensor on the UNCONDITIONAL main line: "
+            f"{[(c['op'], c['shapes']) for c in main_dense]} (dense "
+            f"shapes {sorted(dense_shapes)}); contract: "
+            f"{contract['forbids']}; observed "
+            f"collectives: {seen}",
+            hint="the exchange must dedup and route owned rows via "
+                 "all_to_all; dense collectives belong only in the "
+                 "lax.cond overflow fallback arm",
+            where=where, slug=f"{variant}-alltoall-dense-collective",
+        ))
+    branches: dict = {}
+    for c in cols:
+        if c["branch"] is not None:
+            b = branches.setdefault(c["branch"], {"a2a": False, "dense": False})
+            b["a2a"] = b["a2a"] or c["op"] == "all_to_all"
+            b["dense"] = b["dense"] or is_dense(c)
+    leaky = [k for k, b in branches.items() if b["a2a"] and b["dense"]]
+    if leaky:
+        out.append(_finding(
+            "trace-collective",
+            f"{variant} train step in shard_exchange='alltoall' has "
+            f"conditional branch(es) {leaky} carrying BOTH the all_to_all "
+            f"exchange and a dense row-tensor collective — the dense "
+            f"traffic leaked into the exchange arm; observed "
+            f"collectives: {seen}",
+            hint="only the lax.cond fallback arm may be dense",
+            where=where, slug=f"{variant}-alltoall-dense-in-exchange-arm",
+        ))
+    if not any(c["op"] == "all_to_all" for c in cols):
+        out.append(_finding(
+            "trace-collective",
+            f"{variant} train step in shard_exchange='alltoall' lowered "
+            f"WITHOUT any all_to_all — the exchange is not in effect; "
+            f"observed collectives: {seen}",
+            hint="check resolve_shard_exchange wiring "
+                 "(parallel/embedding.py, parallel/spmd.py)",
+            where=where, slug=f"{variant}-alltoall-missing",
+        ))
+    return out
+
+
+def audit_spmd_exchange(cfg=None) -> list[Finding]:
+    """Collective-traffic contract on the real SPMD train step (lowering
+    only — nothing executes, tables stay abstract).  Needs the 8-device
+    virtual mesh (tests/conftest.py and scripts/check.sh arrange it);
+    vacuous on smaller topologies (e.g. a single real TPU chip)."""
+    import sys
+
+    import jax
+
+    if len(jax.devices()) < 8:
+        # not silent: a --write-baseline run on a blind topology must not
+        # look like a clean contract
+        print(
+            "trace-audit: SPMD collective contract SKIPPED — needs >= 8 "
+            "devices (run under JAX_PLATFORMS=cpu with "
+            "--xla_force_host_platform_device_count=8; scripts/check.sh "
+            "and the analysis CLI arrange this)",
+            file=sys.stderr,
+        )
+        return []
+    from ..core.config import MeshConfig
+    from ..parallel import (
+        abstract_spmd_state, build_mesh, make_context, make_spmd_train_step,
+    )
+
+    base = (cfg or _audit_cfg()).with_overrides(data={"batch_size": 128})
+    mesh = build_mesh(MeshConfig(data_parallel=2, model_parallel=4))
+
+    def lowered_text(mode: str, lazy: bool) -> tuple[str, object]:
+        c = base.with_overrides(
+            model={"shard_exchange": mode},
+            optimizer={"lazy_embedding_updates": lazy},
+        )
+        ctx = make_context(c, mesh)
+        state = abstract_spmd_state(ctx)
+        f = c.model.field_size
+        b = c.data.batch_size
+        batch = {
+            "feat_ids": jax.ShapeDtypeStruct((b, f), jax.numpy.int32),
+            "feat_vals": jax.ShapeDtypeStruct((b, f), jax.numpy.float32),
+            "label": jax.ShapeDtypeStruct((b,), jax.numpy.float32),
+        }
+        step = make_spmd_train_step(ctx, donate=False)
+        return step.lower(state, batch).as_text(), ctx
+
+    out: list[Finding] = []
+    b_local = base.data.batch_size // 2
+    f = base.model.field_size
+    k = base.model.embedding_size
+    dense_rows = {(b_local, f, k), (b_local, f)}
+    n_local = b_local * f
+    lazy_dense = {(n_local, k), (n_local, 1), (n_local,)}
+    for mode, lazy, shapes, variant in (
+        ("psum", False, dense_rows, "dense"),
+        ("alltoall", False, dense_rows, "dense"),
+        ("alltoall", True, dense_rows | lazy_dense, "lazy"),
+    ):
+        text, _ = lowered_text(mode, lazy)
+        out.extend(check_exchange_collectives(
+            text, shapes, mode=mode, variant=variant,
+        ))
+    return out
+
+
 def run_trace_audit(cfg=None) -> list[Finding]:
     """All engine-2 audits against the real entrypoints (abstract values
     only; no step executes).  Importing jax is the price of admission —
@@ -338,4 +596,5 @@ def run_trace_audit(cfg=None) -> list[Finding]:
     findings.extend(audit_predict(cfg))
     findings.extend(audit_buckets())
     findings.extend(audit_train_step(cfg))
+    findings.extend(audit_spmd_exchange(cfg))
     return findings

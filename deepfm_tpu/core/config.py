@@ -88,6 +88,26 @@ class ModelConfig:
     # Pallas fused gather+FM kernel (ops/pallas_ctr.py): "off" | "auto" | "on".
     # "auto" uses it on TPU backends; "on" forces it (interpret mode on CPU).
     fused_kernel: str = "off"
+    # row-sharded lookup collective strategy (parallel/embedding.py):
+    # "psum" = every shard contributes a mostly-zeros [B, F, K] dense tensor,
+    # assembled by lax.psum over the model axis (the original path) |
+    # "alltoall" = dedup the batch ids on-device, route only UNIQUE owner-rows
+    # requests/responses through lax.all_to_all (owned-rows-only traffic;
+    # capacity-bounded with a jit-stable psum fallback on overflow) |
+    # "auto" = alltoall where a real interconnect exists AND the mesh
+    # actually exchanges rows (model_parallel > 1, or lazy updates with
+    # data_parallel > 1); psum on the CPU backend, whose shared-memory
+    # virtual mesh makes the dense assembly a memcpy that the exchange's
+    # sort work cannot beat (measured; parallel/embedding.py
+    # resolve_shard_exchange).
+    shard_exchange: str = "auto"
+    # per-destination-shard request capacity for the alltoall exchange, as a
+    # fraction of the flattened local id stream (B_local*F).  0 = auto:
+    # ceil(N/M) per model shard for the forward exchange, 0.5*N for the lazy
+    # path's per-data-shard unique pack.  Overflow falls back to the dense
+    # path inside the same executable (lax.cond), so any value is safe —
+    # smaller capacity = less ICI traffic but more frequent fallback.
+    shard_exchange_capacity: float = 0.0
 
     def __post_init__(self):
         object.__setattr__(self, "deep_layers", _parse_int_list(self.deep_layers))
@@ -108,6 +128,16 @@ class ModelConfig:
             raise ValueError(
                 f"table_grad must be 'scatter' or 'segsum', "
                 f"got {self.table_grad!r}"
+            )
+        if self.shard_exchange not in ("psum", "alltoall", "auto"):
+            raise ValueError(
+                f"shard_exchange must be 'psum', 'alltoall' or 'auto', "
+                f"got {self.shard_exchange!r}"
+            )
+        if not 0.0 <= self.shard_exchange_capacity <= 1.0:
+            raise ValueError(
+                f"shard_exchange_capacity must be in [0, 1] (a fraction of "
+                f"the local id stream), got {self.shard_exchange_capacity!r}"
             )
         # the fused Pallas kernel owns both gathers AND their backward, so
         # table_grad='segsum' never takes effect on the fused path — reject

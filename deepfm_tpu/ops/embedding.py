@@ -58,17 +58,46 @@ def scaled_embedding(
     return dense_lookup(table, ids) * vals[..., None]
 
 
-def sort_segments(flat_ids: jnp.ndarray):
+def sort_segments(flat_ids: jnp.ndarray, id_bound: int | None = None):
     """Sort ids and describe the equal-id runs.
 
     Returns ``(order, seg, row_id, valid)``: ``order`` sorts the ids,
     ``seg[p]`` is the segment index of sorted position p, ``row_id[s]`` the
     id shared by segment s, ``valid[s]`` whether segment s exists (segments
     form a prefix).  One structure serves every table gathered with the
-    same ids (the lazy-Adam update and the segsum backward below)."""
+    same ids (the lazy-Adam update, the segsum backward below, and the
+    all-to-all shard exchange's routing plan, parallel/embedding.py).
+
+    ``id_bound`` is the caller's STATIC promise that every id lies in
+    ``[0, id_bound)``.  It unlocks the packed single-key sort: XLA's
+    comparator sort pays ~4x for a variadic (key, payload) sort vs one
+    scalar key, and the sort is the dominant cost of every dedup path on
+    CPU/TPU.  When ``bits(id_bound) + ceil(log2 n)`` fits 32 bits, the
+    (id, position) pair packs losslessly into ONE uint32 key — the
+    position in the low bits tie-breaks ascending, i.e. exactly the
+    stable argsort permutation — so one single-key unsigned sort yields
+    both the sorted ids and the order.  (uint32 needs no jax x64 mode; an
+    int64 packing would silently TRUNCATE with x64 off.)  Without the
+    bound, or when it does not fit (e.g. huge-vocab streams), the general
+    variadic argsort runs instead — the flagship shape V=117,581 with
+    B_local*F ~= 20k packs exactly (17 + 15 bits)."""
     n = flat_ids.shape[0]
-    order = jnp.argsort(flat_ids)
-    sid = flat_ids[order]
+    shift = max(1, int(n - 1).bit_length()) if n > 1 else 1
+    if (
+        flat_ids.dtype == jnp.int32
+        and id_bound is not None
+        and n > 1
+        and id_bound <= (1 << (32 - shift))
+    ):
+        key = (flat_ids.astype(jnp.uint32) << shift) | jnp.arange(
+            n, dtype=jnp.uint32
+        )
+        skey = jnp.sort(key)
+        order = (skey & ((1 << shift) - 1)).astype(jnp.int32)
+        sid = (skey >> shift).astype(jnp.int32)  # logical shift: unsigned
+    else:
+        order = jnp.argsort(flat_ids)
+        sid = flat_ids[order]
     first = jnp.concatenate([jnp.ones((1,), bool), sid[1:] != sid[:-1]])
     seg = jnp.cumsum(first) - 1
     row_id = jnp.zeros((n,), sid.dtype).at[seg].set(
@@ -98,7 +127,15 @@ def _segsum_bwd(meta, ids, g):
     flat_ids = ids.reshape(-1)
     n = flat_ids.shape[0]
     flat_g = g.reshape((n,) + tail)
-    order, seg, row_id, valid = sort_segments(flat_ids)
+    # collapse out-of-range ids onto the single sentinel ``rows`` BEFORE
+    # the sort: their cotangents were always dropped (the write below is
+    # mode="drop"), and the bounded non-negative stream unlocks the
+    # packed single-key sort
+    flat_ids = jnp.where(
+        (flat_ids >= 0) & (flat_ids < rows), flat_ids,
+        jnp.asarray(rows, flat_ids.dtype),
+    )
+    order, seg, row_id, valid = sort_segments(flat_ids, rows + 1)
     summed = jax.ops.segment_sum(
         flat_g[order], seg, num_segments=n, indices_are_sorted=True
     )

@@ -35,7 +35,7 @@ from ..models.two_tower import (
 )
 from ..train.optimizer import build_optimizer
 from ..train.step import TrainState
-from .embedding import make_sharded_lookup_fn
+from .embedding import lookup_fn_from_config
 from .mesh import DATA_AXIS, MODEL_AXIS, mesh_shape
 from .spmd import _pmean_grads, _sharded_penalty, padded_vocab
 
@@ -122,7 +122,7 @@ def create_retrieval_spmd_state(
 
 def _local_forward(cfg: Config, params, batch):
     """Local towers -> global item pool -> per-example CE and scores."""
-    lookup = make_sharded_lookup_fn(table_grad=cfg.model.table_grad)
+    lookup = lookup_fn_from_config(cfg)
     towers = apply_two_tower(
         params, batch, cfg=cfg.model, user_lookup_fn=lookup, item_lookup_fn=lookup
     )

@@ -37,7 +37,12 @@ from ..models.base import get_model
 from ..ops.auc import AUCState, auc_init, auc_update
 from ..train.optimizer import build_optimizer
 from ..train.step import TrainState, sigmoid_cross_entropy
-from .embedding import make_sharded_lookup_fn, sharded_l2
+from .embedding import (
+    exchange_capacity,
+    lookup_fn_from_config,
+    resolve_shard_exchange,
+    sharded_l2,
+)
 from .mesh import DATA_AXIS, MODEL_AXIS, mesh_shape
 
 # params keys treated as row-sharded embedding tables (must match the model
@@ -155,6 +160,14 @@ def make_context(cfg: Config, mesh: Mesh) -> SPMDContext:
     )
 
 
+def abstract_spmd_state(ctx: SPMDContext) -> TrainState:
+    """ShapeDtypeStruct pytree of the TrainState — for lowering-only
+    consumers (the trace-time collective audit) that must never
+    materialize the tables."""
+    init_fn = _build_full_init(ctx.cfg, ctx.true_feature_size)
+    return jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+
+
 def create_spmd_state(ctx: SPMDContext, key: jax.Array | None = None) -> TrainState:
     """Initialize the TrainState directly into its shardings: XLA materializes
     each table shard on its own device (deterministic across replicas — the
@@ -210,7 +223,7 @@ def _pmean_grads(grads: dict) -> dict:
 
 
 def _local_loss(cfg: Config, model, params, model_state, batch, rng, train):
-    lookup = make_sharded_lookup_fn(table_grad=cfg.model.table_grad)
+    lookup = lookup_fn_from_config(cfg)
     logits, new_state = model.apply(
         params,
         model_state,
@@ -344,13 +357,22 @@ def make_spmd_train_loop(
 def _build_lazy_local_step(ctx: SPMDContext, model, tx) -> Callable:
     """Per-shard lazy-Adam step body (train/lazy.py, SPMD edition).
 
-    The gradient is taken w.r.t. the psum-ASSEMBLED rows, so no dense table
+    The gradient is taken w.r.t. the ASSEMBLED rows, so no dense table
     gradient (or its data-axis pmean — the dominant ICI cost at large vocab)
-    ever exists.  Instead the per-shard row grads are all-gathered over the
-    data axis (B·F·K floats, independent of vocab size), deduped once with a
+    ever exists.  Instead the per-shard row grads ride the data axis
+    (B·F·K floats, independent of vocab size), are deduped once with a
     global sort — identical on every shard — and each model shard applies
     the updates falling in its row range.  The dense table-L2 term moves
-    into the update (once per unique touched row; see train/lazy.py)."""
+    into the update (once per unique touched row; see train/lazy.py).
+
+    With ``shard_exchange`` resolving to "alltoall", the grad stream gets
+    the dedup-BEFORE-exchange treatment: each data shard segment-sums its
+    local duplicates into a capacity-bounded unique pack first, so the
+    data-axis all_gather moves ``dp·C`` summed rows instead of the full
+    ``B·F`` occurrence stream (C = the unique-pack capacity; a batch whose
+    local uniques exceed it falls back to the dense gather via lax.cond,
+    with the flag pmax-agreed across the data axis so the collective
+    shapes stay group-consistent)."""
     from ..train.lazy import lazy_adam_update_shard, shared_segments
     from ..train.step import LAZY_TABLE_KEYS
 
@@ -367,6 +389,18 @@ def _build_lazy_local_step(ctx: SPMDContext, model, tx) -> Callable:
     emb_mult = cfg.optimizer.embedding_lr_multiplier
     from ..parallel.embedding import sharded_lookup
 
+    # collective strategy (resolved once at trace-build time): the forward
+    # row assembly uses the exchange only when the model axis actually
+    # shards rows; the grad-stream dedup only when the data axis actually
+    # gathers (a singleton-axis exchange is pure sort overhead)
+    mode = resolve_shard_exchange(cfg)
+    fwd_exchange = (
+        "alltoall" if mode == "alltoall" and cfg.mesh.model_parallel > 1
+        else "psum"
+    )
+    dedup_gather = mode == "alltoall" and cfg.mesh.data_parallel > 1
+    cap_frac = cfg.model.shard_exchange_capacity
+
     def local_step(state: TrainState, batch: dict):
         from ..train.lazy import LazyAdamState
 
@@ -381,7 +415,26 @@ def _build_lazy_local_step(ctx: SPMDContext, model, tx) -> Callable:
         ids2d = narrow_ids(batch["feat_ids"], cfg.model.feature_size,
                            cfg.model.narrow_ids)
         ids2d = ids2d.reshape(-1, cfg.model.field_size)
-        rows = {k: sharded_lookup(tables[k], ids2d) for k in keys}
+        # Invalid-id remap (see the sentinel comment below) happens BEFORE
+        # the forward lookup so the grad-dedup and the exchange plan sort
+        # the SAME array — XLA CSE folds them into one sort.  Value-
+        # preserving: remapped ids gather zero rows exactly as the psum
+        # mask (or the zero-init pad-row invariant) produced before.
+        flat_local = ids2d.reshape(-1)
+        n_local = flat_local.shape[0]
+        total_rows = min(tables[k].shape[0] for k in keys) * lax.psum(
+            1, MODEL_AXIS
+        )
+        flat_mapped = jnp.where(
+            (flat_local >= 0) & (flat_local < true_vocab), flat_local,
+            total_rows,
+        )
+        ids_feed = flat_mapped.reshape(ids2d.shape)
+        rows = {
+            k: sharded_lookup(tables[k], ids_feed, exchange=fwd_exchange,
+                              capacity=cap_frac)
+            for k in keys
+        }
 
         def loss_fn(rest, rows):
             def row_lookup(table, _ids):
@@ -410,46 +463,97 @@ def _build_lazy_local_step(ctx: SPMDContext, model, tx) -> Callable:
         updates, new_rest_opt = tx.update(g_rest, rest_opt, rest)
         new_rest = optax.apply_updates(rest, updates)
 
-        # global id stream: all-gather over the data axis (replicated over
-        # the model axis).  Global loss = mean of shard means -> 1/dp scale.
+        # global id stream over the data axis (replicated over the model
+        # axis).  Global loss = mean of shard means -> 1/dp scale.
         # One sort/segment structure shared by the tables (identical ids).
-        dp = lax.psum(1, DATA_AXIS)
-        flat_local = ids2d.reshape(-1)
-        flat_ids = lax.all_gather(flat_local, DATA_AXIS, tiled=True)
         # Invalid ids must not train table rows: ids >= padded vocab
-        # contributed ZERO rows in the forward (sharded_lookup masks them),
-        # and ids in the padding gap [true_vocab, padded_vocab) would knock
+        # contributed ZERO rows in the forward (the remap above), and ids
+        # in the padding gap [true_vocab, padded_vocab) would knock
         # zero-init pad rows nonzero (breaking the pad-rows-stay-zero
-        # invariant init/restore rely on).  Remap both — and negatives — to
-        # the sentinel ``total_rows``, which falls outside every shard's
-        # [offset, offset+rows) window in lazy_adam_update_shard and is
-        # discarded there.
-        total_rows = min(tables[k].shape[0] for k in keys) * lax.psum(
-            1, MODEL_AXIS
-        )
-        flat_ids = jnp.where(
-            (flat_ids >= 0) & (flat_ids < true_vocab), flat_ids, total_rows
-        )
-        order, seg, row_id, valid = shared_segments(flat_ids)
+        # invariant init/restore rely on).  ``flat_mapped`` carries both —
+        # and negatives — at the sentinel ``total_rows``, which falls
+        # outside every shard's [offset, offset+rows) window in
+        # lazy_adam_update_shard and is discarded there.
+        dp = lax.psum(1, DATA_AXIS)
         step1 = state.step + 1
         lr = schedule_value(lr_sched, state.step) * emb_mult
-        new_tables, new_m, new_v = {}, {}, {}
-        for k in keys:
-            g = lax.all_gather(
-                g_rows[k].reshape(flat_local.shape[0], -1),
-                DATA_AXIS, tiled=True,
-            ) / dp
-            gsum = jax.ops.segment_sum(
-                g[order], seg, num_segments=flat_ids.shape[0],
-                indices_are_sorted=True,
+
+        def apply_updates(row_id, gsum_by_key, valid):
+            out = {}
+            for k in keys:
+                out[k] = lazy_adam_update_shard(
+                    tables[k], lazy_state.m[k], lazy_state.v[k],
+                    row_id, gsum_by_key[k], valid,
+                    lax.axis_index(MODEL_AXIS) * tables[k].shape[0],
+                    step1, cfg.optimizer,
+                    learning_rate=lr, l2_reg=cfg.model.l2_reg,
+                )
+            return out
+
+        def update_full(_):
+            """Dense gather: every occurrence's grad rides the data axis
+            (the original path; also the unique-pack overflow fallback)."""
+            flat_ids = lax.all_gather(flat_mapped, DATA_AXIS, tiled=True)
+            order, seg, row_id, valid = shared_segments(
+                flat_ids, total_rows + 1
             )
-            new_tables[k], new_m[k], new_v[k] = lazy_adam_update_shard(
-                tables[k], lazy_state.m[k], lazy_state.v[k],
-                row_id, gsum, valid,
-                lax.axis_index(MODEL_AXIS) * tables[k].shape[0],
-                step1, cfg.optimizer,
-                learning_rate=lr, l2_reg=cfg.model.l2_reg,
+            gsum_by_key = {}
+            for k in keys:
+                g = lax.all_gather(
+                    g_rows[k].reshape(n_local, -1), DATA_AXIS, tiled=True,
+                ) / dp
+                gsum_by_key[k] = jax.ops.segment_sum(
+                    g[order], seg, num_segments=flat_ids.shape[0],
+                    indices_are_sorted=True,
+                )
+            return apply_updates(row_id, gsum_by_key, valid)
+
+        if dedup_gather:
+            # dedup BEFORE the exchange: one local sort shared by the
+            # tables folds duplicate rows into per-unique sums, and only a
+            # capacity-bounded unique pack rides the all_gather
+            # auto = N/2 unique slots per data shard (core/config.py); the
+            # fraction is explicit — num_shards plays no role here
+            cap = exchange_capacity(n_local, 1, cap_frac or 0.5)
+            order_l, seg_l, row_l, valid_l = shared_segments(
+                flat_mapped, total_rows + 1
             )
+            n_unique = jnp.sum(valid_l.astype(jnp.int32))
+            # collective-shape consistency: every data shard in the gather
+            # group must take the same branch
+            overflow = lax.pmax(
+                (n_unique > cap).astype(jnp.int32), DATA_AXIS
+            ) > 0
+
+            def update_dedup(_):
+                ids_pack = jnp.where(valid_l[:cap], row_l[:cap], total_rows)
+                ids_g = lax.all_gather(ids_pack, DATA_AXIS, tiled=True)
+                order, seg, row_id, valid = shared_segments(
+                    ids_g, total_rows + 1
+                )
+                gsum_by_key = {}
+                for k in keys:
+                    g2 = g_rows[k].reshape(n_local, -1)
+                    gsum_l = jax.ops.segment_sum(
+                        g2[order_l], seg_l, num_segments=n_local,
+                        indices_are_sorted=True,
+                    )[:cap]
+                    g_g = lax.all_gather(gsum_l, DATA_AXIS, tiled=True) / dp
+                    gsum_by_key[k] = jax.ops.segment_sum(
+                        g_g[order], seg, num_segments=ids_g.shape[0],
+                        indices_are_sorted=True,
+                    )
+                return apply_updates(row_id, gsum_by_key, valid)
+
+            if cap >= n_local:  # overflow statically impossible
+                updated = update_dedup(0)
+            else:
+                updated = lax.cond(overflow, update_full, update_dedup, 0)
+        else:
+            updated = update_full(0)
+        new_tables = {k: updated[k][0] for k in keys}
+        new_m = {k: updated[k][1] for k in keys}
+        new_v = {k: updated[k][2] for k in keys}
         metrics = {
             # CE only (table-L2 folds into the lazy update); 'ce' is the
             # cross-path comparable quantity (docs/PARITY.md)
@@ -546,7 +650,7 @@ def make_spmd_predict_step(ctx: SPMDContext) -> Callable:
             cfg=cfg.model,
             train=False,
             rng=None,
-            lookup_fn=make_sharded_lookup_fn(),
+            lookup_fn=lookup_fn_from_config(cfg),
         )
         return jax.nn.sigmoid(logits)
 

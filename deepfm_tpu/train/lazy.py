@@ -45,11 +45,14 @@ def init_lazy_state(tables: dict) -> LazyAdamState:
     return LazyAdamState(m=zeros, v={k: jnp.zeros_like(t) for k, t in tables.items()})
 
 
-def segment_rows(flat_ids: jnp.ndarray, flat_grads: jnp.ndarray):
+def segment_rows(flat_ids: jnp.ndarray, flat_grads: jnp.ndarray,
+                 id_bound: int | None = None):
     """Dedup row updates: (ids [N], grads [N, K]) ->
     (row_id [N], summed [N, K], valid [N]) where only the first U entries
-    (U = unique count) are live; the rest are zero-masked padding."""
-    order, seg, row_id, valid = shared_segments(flat_ids)
+    (U = unique count) are live; the rest are zero-masked padding.
+    ``id_bound``: static exclusive upper bound on the (non-negative) ids,
+    unlocking the packed single-key sort (ops/embedding.py)."""
+    order, seg, row_id, valid = shared_segments(flat_ids, id_bound)
     summed = jax.ops.segment_sum(
         flat_grads[order], seg, num_segments=flat_ids.shape[0],
         indices_are_sorted=True,
@@ -88,7 +91,7 @@ def lazy_adam_update(
     g2 = row_grads.reshape(flat_ids.shape[0], width)
 
     if segmented is None:
-        row_id, gsum, valid = segment_rows(flat_ids, g2)
+        row_id, gsum, valid = segment_rows(flat_ids, g2, shape[0])
     else:
         order, seg, row_id, valid = segmented
         gsum = jax.ops.segment_sum(
@@ -187,11 +190,15 @@ def lazy_adam_update_shard(
     return new_t.reshape(shape), new_m.reshape(shape), new_v.reshape(shape)
 
 
-def shared_segments(flat_ids: jnp.ndarray):
+def shared_segments(flat_ids: jnp.ndarray, id_bound: int | None = None):
     """Precompute the sort/segment structure once for tables sharing ids.
 
     Alias of ops/embedding.py ``sort_segments`` (also the segsum-backward
-    building block) — one implementation to keep in sync."""
+    building block AND the all-to-all shard exchange's routing plan,
+    parallel/embedding.py ``exchange_plan``) — one implementation (packed
+    single-key sort) to keep in sync.  The sharded lazy step feeds the
+    SAME remapped id stream here and to the forward exchange so XLA CSE
+    folds their sorts into one (parallel/spmd.py)."""
     from ..ops.embedding import sort_segments
 
-    return sort_segments(flat_ids)
+    return sort_segments(flat_ids, id_bound)

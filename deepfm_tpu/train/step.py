@@ -238,7 +238,7 @@ def _make_lazy_train_step(cfg: Config, model, tx) -> Callable:
         # table (fm_v may carry aligned-window padding rows beyond fm_w)
         min_rows = min(tables[k].shape[0] for k in keys)
         flat_ids = jnp.clip(ids.reshape(-1), 0, min_rows - 1)
-        segs = shared_segments(flat_ids)
+        segs = shared_segments(flat_ids, min_rows)
         step1 = state.step + 1
         new_tables, new_m, new_v = {}, {}, {}
         for key in keys:

@@ -14,5 +14,10 @@ if [[ "${1:-}" == "--json" ]]; then
     fmt="json"
 fi
 
-exec env JAX_PLATFORMS=cpu python -m deepfm_tpu.analysis deepfm_tpu \
+# the trace audit's collective contract lowers the sharded train step on an
+# 8-device virtual CPU mesh (the CLI also arranges this itself when
+# JAX_PLATFORMS=cpu; exported here so the gate never silently degrades)
+exec env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="${XLA_FLAGS:+$XLA_FLAGS }--xla_force_host_platform_device_count=8" \
+    python -m deepfm_tpu.analysis deepfm_tpu \
     --trace-audit --format "$fmt" --baseline analysis_baseline.json

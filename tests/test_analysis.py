@@ -915,6 +915,105 @@ class TestTraceAudit:
         assert n_in != n_leaves + 2  # the audit's discriminator fires
 
 
+class TestCollectiveContract:
+    """Engine-2 collective-traffic contract (trace_audit.py
+    audit_spmd_exchange): the alltoall-mode sharded train step must not
+    move the dense row tensor outside the lax.cond fallback arm."""
+
+    def _lower_psum(self):
+        import jax
+        import jax.numpy as jnp
+
+        from deepfm_tpu.analysis.trace_audit import _audit_cfg
+        from deepfm_tpu.core.config import MeshConfig
+        from deepfm_tpu.parallel import (
+            abstract_spmd_state, build_mesh, make_context,
+            make_spmd_train_step,
+        )
+
+        base = _audit_cfg().with_overrides(data={"batch_size": 128})
+        mesh = build_mesh(MeshConfig(data_parallel=2, model_parallel=4))
+        c = base.with_overrides(model={"shard_exchange": "psum"})
+        ctx = make_context(c, mesh)
+        state = abstract_spmd_state(ctx)
+        b, f = 128, c.model.field_size
+        batch = {
+            "feat_ids": jax.ShapeDtypeStruct((b, f), jnp.int32),
+            "feat_vals": jax.ShapeDtypeStruct((b, f), jnp.float32),
+            "label": jax.ShapeDtypeStruct((b,), jnp.float32),
+        }
+        step = make_spmd_train_step(ctx, donate=False)
+        text = step.lower(state, batch).as_text()
+        return text, {(64, f, 32), (64, f)}
+
+    def test_exchange_contract_clean_on_real_step(self):
+        from deepfm_tpu.analysis.trace_audit import audit_spmd_exchange
+
+        findings = audit_spmd_exchange()
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_seeded_dense_regression_caught(self):
+        """A psum-mode lowering fed through the alltoall contract — the
+        shape a regression would take if resolve_shard_exchange wiring
+        broke — must be flagged on BOTH axes: dense traffic on the main
+        line, and no all_to_all present."""
+        from deepfm_tpu.analysis.trace_audit import (
+            check_exchange_collectives,
+        )
+
+        text, dense = self._lower_psum()
+        viol = check_exchange_collectives(text, dense, mode="alltoall")
+        assert any("UNCONDITIONAL main line" in v.message for v in viol)
+        assert any("WITHOUT any all_to_all" in v.message for v in viol)
+        assert all(v.rule == "trace-collective" for v in viol)
+        # the same lowering satisfies the psum contract (detector sees
+        # the dense all-reduce)...
+        assert check_exchange_collectives(text, dense, mode="psum") == []
+        # ...and a blind detector (wrong dense shapes) fails LOUDLY in
+        # psum mode instead of passing alltoall vacuously
+        blind = check_exchange_collectives(
+            text, {(1, 2, 3)}, mode="psum"
+        )
+        assert blind and "detector" in blind[0].message
+
+    def test_collective_scanner_branch_indexing(self):
+        """summarize_collectives must separate case branches (the fallback
+        arm may be dense; the exchange arm may not) and read region-op
+        signatures from their closing line."""
+        from deepfm_tpu.analysis.trace_audit import summarize_collectives
+
+        text = "\n".join([
+            "module {",
+            "  func.func private @body(%arg0: tensor<8x4xf32>)"
+            " -> tensor<4x3xf32> {",
+            '    %g = "stablehlo.all_gather"(%arg0) : (tensor<8x4xf32>)'
+            " -> (tensor<8x16xf32>)",
+            '    %1 = "stablehlo.case"(%i) ({',
+            '      %2 = "stablehlo.all_to_all"(%arg0) :'
+            " (tensor<4x2xi32>) -> tensor<4x2xi32>",
+            "      stablehlo.return %2 : tensor<4x2xi32>",
+            "    }, {",
+            '      %3 = "stablehlo.all_reduce"(%arg0) ({',
+            "      ^bb0(%a: tensor<f32>, %b: tensor<f32>):",
+            "        %s = stablehlo.add %a, %b : tensor<f32>",
+            "        stablehlo.return %s : tensor<f32>",
+            "      }) : (tensor<16x8xf32>) -> tensor<16x8xf32>",
+            "      stablehlo.return %3 : tensor<16x8xf32>",
+            "    }) : (tensor<i32>) -> tensor<4x3xf32>",
+            "    return %1 : tensor<4x3xf32>",
+            "  }",
+            "}",
+        ])
+        cols = summarize_collectives(text)
+        by_op = {c["op"]: c for c in cols}
+        assert by_op["all_gather"]["branch"] is None
+        assert by_op["all_gather"]["shapes"] == [(8, 4)]
+        assert by_op["all_to_all"]["branch"] == (1, 0)
+        assert by_op["all_reduce"]["branch"] == (1, 1)
+        # region-op signature picked up from the closing line
+        assert by_op["all_reduce"]["shapes"] == [(16, 8)]
+
+
 class TestSeededViolationsEndToEnd:
     """The acceptance trio: a tracer .item() inside jit, an unguarded
     mutation of a locked attribute, and an off-bucket request shape are
