@@ -32,6 +32,15 @@ not a benchmark:
   silent x64 upgrade doubles bytes and halves serving throughput before
   any test notices) and the predict output is exactly float32 (no
   surprise bf16 widening of the wire format).
+* **paging audit** — lower the tiered store's steady-state slot-space
+  train step (``tiered.step.make_paged_train_step``) under
+  ``jax.transfer_guard("disallow")`` and hold it to the paging contract:
+  the lowered executable contains NO host transfers outside the
+  designated staging ops — i.e. every host byte enters through the
+  declared arguments (translated slot ids + the pager's staged miss
+  pack, which must appear as lowered PARAMETERS, never baked
+  constants), the state is donated (hot-cache buffers update in place),
+  and the output state specs match the input (no dtype/shape drift).
 * **collective-traffic audit** — lower the REAL sharded train step on the
   8-device virtual mesh in each ``shard_exchange`` mode and hold the
   lowering to its traffic contract: in ``alltoall`` mode the program must
@@ -343,6 +352,166 @@ def audit_train_step(cfg=None) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# paging contract (tiered embedding store, deepfm_tpu/tiered)
+
+# audit shapes: small but structurally real (two tables, staging pack)
+_PAGED_CAPACITY = 256
+_PAGED_STAGE = 64
+_PAGED_BATCH = 16
+
+
+def _abstract_paged_inputs(cfg, capacity: int, stage_rows: int,
+                           batch_rows: int):
+    """Abstract (state, batch, stage_slots, stage) for the paged step —
+    every array a ShapeDtypeStruct, nothing materializes."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..tiered.step import PagedState, init_hot
+    from ..tiered.trainer import _rest_template, _split_rest, _widths
+
+    template = jax.eval_shape(lambda: _rest_template(cfg))
+    rest, _, rest_opt, _, keys = _split_rest(cfg, template)
+    widths = _widths(cfg, keys)
+    hot = jax.eval_shape(lambda: init_hot(widths, capacity))
+    state = PagedState(
+        step=jax.ShapeDtypeStruct((), jnp.int32),
+        rest=rest,
+        model_state=template.model_state,
+        rest_opt=rest_opt,
+        hot=hot,
+        rng=template.rng,
+    )
+    f = cfg.model.field_size
+    batch = {
+        "slot_ids": jax.ShapeDtypeStruct((batch_rows, f), jnp.int32),
+        "feat_vals": jax.ShapeDtypeStruct((batch_rows, f), jnp.float32),
+        "label": jax.ShapeDtypeStruct((batch_rows,), jnp.float32),
+    }
+    stage_slots = jax.ShapeDtypeStruct((stage_rows,), jnp.int32)
+    stage = {
+        k: {part: jax.ShapeDtypeStruct(
+            (stage_rows,) if w == 1 else (stage_rows, w), jnp.float32)
+            for part in ("rows", "m", "v")}
+        for k, w in widths.items()
+    }
+    return state, batch, stage_slots, stage
+
+
+def audit_paged_step(cfg=None, step_builder=None) -> list[Finding]:
+    """Paging contract on the tiered steady-state train step: the lowered
+    executable moves host data ONLY through the designated staging
+    arguments.  ``step_builder(cfg, capacity)`` lets the seeded-violation
+    tests feed a smuggling step through the same checks."""
+    import jax
+
+    out: list[Finding] = []
+    cfg = cfg or _audit_cfg()
+    where = "deepfm_tpu/tiered/step.py"
+    if step_builder is None:
+        from ..tiered.step import make_paged_train_step
+
+        def step_builder(c, capacity):
+            return make_paged_train_step(c, capacity)
+
+    state, batch, stage_slots, stage = _abstract_paged_inputs(
+        cfg, _PAGED_CAPACITY, _PAGED_STAGE, _PAGED_BATCH
+    )
+    step = step_builder(cfg, _PAGED_CAPACITY)
+    lowered = None
+    try:
+        with jax.transfer_guard("disallow"):
+            try:
+                lowered = step.lower(state, batch, stage_slots, stage)
+            except TypeError:
+                # a step that dropped the staging arguments from its
+                # signature (baking the pack instead) still lowers — the
+                # leaf-count contract below convicts it
+                lowered = step.lower(state, batch)
+    except Exception as e:
+        out.append(_finding(
+            "trace-transfer",
+            f"lowering the paged train step under "
+            f"transfer_guard('disallow') raised {type(e).__name__}: {e} — "
+            f"the steady-state step performs a host transfer outside the "
+            f"designated staging ops",
+            hint="all host data must enter via the staged miss pack / "
+                 "slot-id arguments (tiered/step.py)",
+            where=where, slug="paged-transfer-guard",
+        ))
+        return out
+    # staging pack leaves must be PARAMETERS of the executable: a pack
+    # baked as constants is a host transfer smuggled past the pager
+    n_expected = sum(
+        len(jax.tree_util.tree_leaves(t))
+        for t in (state, batch, stage_slots, stage)
+    )
+    n_in = len(jax.tree_util.tree_leaves(lowered.in_avals))
+    if n_in != n_expected:
+        out.append(_finding(
+            "trace-transfer",
+            f"lowered paged step has {n_in} input leaves, expected "
+            f"{n_expected} (state + batch + staged miss pack) — staging "
+            f"data was baked into the executable instead of arriving as "
+            f"arguments (an undeclared per-step host transfer)",
+            hint="pass the pager's staging pack as arguments "
+                 "(tiered/step.py make_paged_train_step)",
+            where=where, slug="paged-staging-baked",
+        ))
+    # donation: hot-cache buffers must update in place
+    try:
+        args_info = lowered.args_info
+        state_info = args_info[0][0]
+        donated = [bool(getattr(a, "donated", False))
+                   for a in jax.tree_util.tree_leaves(state_info)]
+    except (AttributeError, IndexError, KeyError, TypeError):
+        donated = []
+    if donated and not all(donated):
+        n_bad = sum(1 for d in donated if not d)
+        out.append(_finding(
+            "trace-donation",
+            f"{n_bad}/{len(donated)} paged-state leaves are NOT donated — "
+            f"the hot cache (rows + moments) would copy every step "
+            f"instead of updating in place in HBM",
+            hint="jit with donate_argnums=(0,) "
+                 "(tiered/step.py make_paged_train_step)",
+            where=where, slug="paged-not-donated",
+        ))
+    elif not donated:
+        out.append(_finding(
+            "trace-donation",
+            "could not read donation info from the lowered paged step "
+            "(args_info missing) — the paging donation contract is "
+            "unverified",
+            hint="jax upgrade changed the AOT API; update the audit",
+            where=where, slug="paged-donation-unverified",
+        ))
+    # state spec stability: drift = recompile every step + cache bloat
+    new_state = lowered.out_info[0]
+    old_specs = [(str(a.dtype), tuple(a.shape))
+                 for a in jax.tree_util.tree_leaves(state)]
+    new_specs = [(str(a.dtype), tuple(a.shape))
+                 for a in jax.tree_util.tree_leaves(new_state)]
+    if old_specs != new_specs:
+        out.append(_finding(
+            "trace-dtype",
+            "paged step output state specs differ from its input state — "
+            "the steady-state executable would recompile every step",
+            where=where, slug="paged-state-drift",
+        ))
+    f64 = [a for a in jax.tree_util.tree_leaves(lowered.out_info)
+           if str(getattr(a, "dtype", "")) == "float64"]
+    if f64:
+        out.append(_finding(
+            "trace-dtype",
+            f"paged step emits float64 ({len(f64)} leaves) — silent x64 "
+            f"promotion",
+            where=where, slug="paged-f64",
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # collective-traffic contract (sharded-lookup exchange, parallel/embedding.py)
 
 _COLLECTIVE_OPS = (
@@ -596,5 +765,6 @@ def run_trace_audit(cfg=None) -> list[Finding]:
     findings.extend(audit_predict(cfg))
     findings.extend(audit_buckets())
     findings.extend(audit_train_step(cfg))
+    findings.extend(audit_paged_step(cfg))
     findings.extend(audit_spmd_exchange(cfg))
     return findings

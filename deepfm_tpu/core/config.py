@@ -44,6 +44,16 @@ def _parse_float_list(s: str | Sequence[float]) -> tuple[float, ...]:
     return tuple(float(x) for x in s)
 
 
+def packed_sort_id_bound(n: int) -> int:
+    """Largest EXCLUSIVE id bound the packed single-key sort accepts for an
+    ``n``-id stream (``ops/embedding.py sort_segments``): the (id,
+    position) pair must fit one uint32 key, so ``bits(bound) +
+    ceil(log2 n) <= 32``.  Lives here (pure int math, no jax import) so
+    config-time validation and the sort share ONE definition."""
+    shift = max(1, int(n - 1).bit_length()) if n > 1 else 1
+    return 1 << (32 - shift)
+
+
 @dataclass(frozen=True)
 class ModelConfig:
     """DeepFM model hyperparameters (reference ps:50-69, notebook overrides cell 4)."""
@@ -108,6 +118,21 @@ class ModelConfig:
     # path inside the same executable (lax.cond), so any value is safe —
     # smaller capacity = less ICI traffic but more frequent fallback.
     shard_exchange_capacity: float = 0.0
+    # tiered giant-vocab embedding store (deepfm_tpu/tiered): page rows +
+    # lazy-Adam moments through HBM hot cache <- pinned-host backing <-
+    # object-store cold tier instead of holding the table resident.
+    tiered_embeddings: bool = False
+    # device-resident hot-cache slots (0 = auto: next pow2 >= 2*B*F); must
+    # hold at least one batch's flattened id stream
+    tiered_hot_slots: int = 0
+    # staged rows per step, the miss pack's fixed shape (0 = auto: B*F)
+    tiered_stage_rows: int = 0
+    # pinned host-memory backing rows (0 = auto: 8*hot slots)
+    tiered_host_rows: int = 0
+    # rows per cold-tier page (one ranged read / one overlay write)
+    tiered_page_rows: int = 1024
+    # cold-tier root: object-store prefix URL or local directory
+    tiered_cold_url: str = ""
 
     def __post_init__(self):
         object.__setattr__(self, "deep_layers", _parse_int_list(self.deep_layers))
@@ -160,6 +185,23 @@ class ModelConfig:
                 "backends): the fused kernel supplies its own backward. "
                 "Set fused_kernel='off' to guarantee the segsum backward.",
                 stacklevel=2,
+            )
+        if self.tiered_page_rows < 1:
+            raise ValueError(
+                f"tiered_page_rows must be >= 1, got {self.tiered_page_rows}"
+            )
+        for name in ("tiered_hot_slots", "tiered_stage_rows",
+                     "tiered_host_rows"):
+            if getattr(self, name) < 0:
+                raise ValueError(
+                    f"{name} must be >= 0 (0 = auto), got "
+                    f"{getattr(self, name)}"
+                )
+        if self.tiered_embeddings and self.fused_kernel != "off":
+            raise ValueError(
+                "tiered_embeddings pages rows through a slot-space cache; "
+                "the fused kernel gathers a RESIDENT table — use "
+                "fused_kernel='off' with tiered embeddings"
             )
 
 
@@ -338,6 +380,94 @@ class Config:
     data: DataConfig = field(default_factory=DataConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     run: RunConfig = field(default_factory=RunConfig)
+
+    def __post_init__(self):
+        """Cross-section contracts no single section can check.
+
+        A mis-sized exchange capacity or an unpackable sort bound does
+        not produce a wrong answer — it produces a SLOW one (permanent
+        psum fallback, variadic argsort), which nothing downstream would
+        ever flag.  Validate at config time: degenerate-by-construction
+        shapes raise, merely-suspicious ones warn loudly."""
+        import math
+        import warnings
+
+        m, o, d, mesh = self.model, self.optimizer, self.data, self.mesh
+        mp, dp = mesh.model_parallel, mesh.data_parallel
+        # 1. alltoall request capacity vs the batch shape: a fraction so
+        # small that one example's field_size distinct ids cannot fit even
+        # when spread perfectly across owners means the overflow psum
+        # fallback engages on essentially EVERY batch — the exchange would
+        # silently run as (slower-than-)psum forever.
+        if m.shard_exchange_capacity > 0 and m.shard_exchange != "psum" \
+                and mp > 1:
+            n_local = -(-d.batch_size // max(1, dp)) * m.field_size
+            cap = max(1, min(
+                math.ceil(m.shard_exchange_capacity * n_local), n_local))
+            if cap * mp < m.field_size:
+                raise ValueError(
+                    f"shard_exchange_capacity={m.shard_exchange_capacity} "
+                    f"gives {cap} request slots/owner x {mp} owners < "
+                    f"field_size={m.field_size}: one example's distinct "
+                    f"ids cannot fit, so the overflow psum fallback would "
+                    f"engage on every batch — raise the capacity (0 = "
+                    f"auto: ceil(N/M))"
+                )
+            even = -(-n_local // mp)
+            if cap < -(-even // 2):
+                warnings.warn(
+                    f"shard_exchange_capacity={m.shard_exchange_capacity} "
+                    f"({cap} slots/owner) is under half the even-spread "
+                    f"requirement ceil(N/M)={even} for "
+                    f"N={n_local} local ids on {mp} owners — expect "
+                    f"frequent overflow fallback to the dense psum path "
+                    f"(parallel/embedding.py)", stacklevel=2,
+                )
+        # 2. packed-sort id bound: the dedup paths (exchange plan, lazy
+        # pack) sort (id, position) packed into ONE uint32 key; a vocab
+        # too large for the local stream length falls back to the ~4x
+        # variadic argsort.  Correct, but the dominant sort cost — say so.
+        exchanges = mp > 1 or (o.lazy_embedding_updates and dp > 1)
+        if exchanges and dp > 0:
+            n_local = -(-d.batch_size // dp) * m.field_size
+            bound = m.feature_size + 1  # +1: the out-of-range sentinel
+            if bound > packed_sort_id_bound(n_local):
+                warnings.warn(
+                    f"feature_size={m.feature_size} exceeds the packed-"
+                    f"sort id bound {packed_sort_id_bound(n_local)} for "
+                    f"{n_local} local ids/shard: dedup sorts fall back to "
+                    f"the ~4x variadic argsort (ops/embedding.py "
+                    f"sort_segments).  Tiered embeddings "
+                    f"(model.tiered_embeddings) probe in SLOT space and "
+                    f"keep the packed sort at any vocabulary.",
+                    stacklevel=2,
+                )
+        # 3. tiered cache geometry vs the batch's id stream
+        if m.tiered_embeddings:
+            bf = d.batch_size * m.field_size
+            if 0 < m.tiered_hot_slots < bf:
+                raise ValueError(
+                    f"tiered_hot_slots={m.tiered_hot_slots} cannot hold "
+                    f"one batch's id stream (batch_size*field_size={bf})"
+                )
+            if 0 < m.tiered_stage_rows < bf:
+                warnings.warn(
+                    f"tiered_stage_rows={m.tiered_stage_rows} < "
+                    f"batch_size*field_size={bf}: a cache-cold batch can "
+                    f"miss on every id and overflow the staging pack "
+                    f"(the pager raises at run time)", stacklevel=2,
+                )
+            h = m.tiered_host_rows
+            if h and h - max(1, h // 16) < bf:
+                # one fill must fit inside the host tier's serviceable
+                # window (capacity minus one eviction chunk) or a cold
+                # batch's miss fetch cannot be satisfied (HostTier
+                # raises rather than thrash)
+                raise ValueError(
+                    f"tiered_host_rows={h} cannot service one batch's "
+                    f"miss fetch (window {h - max(1, h // 16)} < "
+                    f"batch_size*field_size={bf})"
+                )
 
     # ---- overrides ------------------------------------------------------
 

@@ -133,16 +133,79 @@ class HttpObjectStore:
         return self._retry.call(fn, classify=_is_transient)
 
     # -- data path ---------------------------------------------------------
-    def open_read(self, url: str, *, offset: int = 0) -> BinaryIO:
-        """Raw streaming GET; ``offset`` issues a ``Range`` read.
+    def open_read(self, url: str, *, offset: int = 0,
+                  length: int | None = None) -> BinaryIO:
+        """Raw streaming GET; ``offset``/``length`` issue a ``Range`` read
+        (``length`` bounds the span to ``[offset, offset+length)`` — the
+        cold-tier row-page path, which must never stream a whole segment).
 
         CAUTION: a connection dropped mid-body surfaces as a CLEAN EOF
         under sized reads (urllib does not raise IncompleteRead for
         ``read(n)``), i.e. silent truncation.  Data-plane consumers use
-        :meth:`open_read_resuming` instead."""
-        headers = {"Range": f"bytes={offset}-"} if offset else {}
+        :meth:`open_read_resuming`; bounded-span consumers use
+        :meth:`get_range`, which verifies the byte count."""
+        if length is not None and length < 0:
+            raise ValueError(f"length must be >= 0, got {length}")
+        if length == 0:
+            # a zero-length span has no valid Range header form; the
+            # contract (mirroring get_range) is simply an empty stream
+            import io
+
+            return io.BytesIO(b"")
+        if length is not None:
+            headers = {"Range": f"bytes={offset}-{offset + length - 1}"}
+        elif offset:
+            headers = {"Range": f"bytes={offset}-"}
+        else:
+            headers = {}
         return self._retrying(
             lambda: self._request("GET", url, headers=headers))
+
+    def get_range(self, url: str, offset: int, length: int) -> bytes:
+        """Exactly the bytes ``[offset, offset+length)`` of an object (or
+        up to its end, whichever is shorter), fully read under ``retry``.
+
+        The whole read runs inside the retried closure with the byte
+        count VERIFIED against the response headers: a connection dropped
+        mid-span — which sized reads otherwise surface as clean EOF, i.e.
+        silent truncation — classifies as transient and re-fetches the
+        span.  Servers without Range support (HTTP 200) are sliced
+        client-side, so callers always get span semantics."""
+        if length < 0 or offset < 0:
+            raise ValueError(
+                f"offset/length must be >= 0, got {offset}/{length}")
+        if length == 0:
+            return b""
+        headers = {"Range": f"bytes={offset}-{offset + length - 1}"}
+
+        def _get() -> bytes:
+            with self._request("GET", url, headers=headers) as r:
+                data = r.read()
+                if r.status == 200:
+                    # no Range support: full body came back — verify it
+                    # first, then slice the span out
+                    cl = r.headers.get("Content-Length")
+                    if cl is not None and len(data) < int(cl):
+                        raise ObjectStoreError(
+                            f"GET {url} truncated: {len(data)}/{cl} bytes",
+                            url=url, retryable=True)
+                    return data[offset:offset + length]
+                expected = length
+                crange = r.headers.get("Content-Range", "")
+                total_s = crange.rpartition("/")[2]
+                if total_s.isdigit():
+                    expected = max(0, min(length, int(total_s) - offset))
+                elif r.headers.get("Content-Length") is not None:
+                    expected = min(length,
+                                   int(r.headers["Content-Length"]))
+                if len(data) < expected:
+                    raise ObjectStoreError(
+                        f"ranged GET {url} [{offset}, {offset + length}) "
+                        f"truncated: {len(data)}/{expected} bytes",
+                        url=url, retryable=True)
+                return data[:length]
+
+        return self._retrying(_get)
 
     def open_read_resuming(self, url: str, *, offset: int = 0,
                            max_resumes: int = 5) -> "ResumingStream":

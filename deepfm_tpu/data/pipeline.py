@@ -475,6 +475,15 @@ class DevicePrefetcher:
     ps:165): a daemon thread decodes/device_puts ``depth`` batches ahead so
     the accelerator never waits on the host.
 
+    ``observer`` (optional) sees each RAW host batch in the worker thread
+    before placement — i.e. up to ``depth`` batches before the training
+    loop consumes it.  This is the tiered embedding store's ahead-of-time
+    prefetch hook (deepfm_tpu/tiered): the pipeline knows the next
+    batches' ids before the step needs them, so
+    ``TieredTrainer.observer()`` pushes them to the cold→host pager here.
+    Observers must be fast and non-raising (an exception would kill the
+    feed); the tiered observer just enqueues ids to a background worker.
+
     Abandoning iteration early?  Call ``close()`` (or use as a context
     manager) — otherwise the worker would sit blocked on a full queue holding
     ``depth`` device-resident batches alive.
@@ -488,6 +497,7 @@ class DevicePrefetcher:
         put: Callable[[dict], dict],
         *,
         depth: int = 2,
+        observer: Callable[[dict], None] | None = None,
     ):
         self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
         self._err: BaseException | None = None
@@ -505,6 +515,8 @@ class DevicePrefetcher:
         def worker():
             try:
                 for b in batches:
+                    if observer is not None:
+                        observer(b)
                     if not offer(put(b)):
                         return
             except BaseException as e:  # surfaced on next __next__

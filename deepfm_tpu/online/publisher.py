@@ -216,6 +216,67 @@ class ModelPublisher:
             watermark=float(watermark),
             extra=extra or {},
         )
+        return self._publish_artifact(
+            manifest, lambda dest: export_servable(cfg, state, dest)
+        )
+
+    def publish_tiered(
+        self,
+        cfg,
+        trainer,
+        *,
+        cursor: dict | None = None,
+        watermark: float = 0.0,
+        extra: dict | None = None,
+    ) -> Manifest:
+        """Publish a TIERED model (deepfm_tpu/tiered): run the trainer's
+        flush barrier (dirty rows+moments hot→host→cold) FIRST, then
+        commit a manifest whose ``extra["tiered"]`` records the cold
+        tier's consistent ``page_versions`` snapshot — a serving reader
+        pinned to that snapshot (``tiered.serving.TieredScorer``) sees
+        exactly the published step's rows no matter what the live trainer
+        flushes afterwards (copy-on-write overlays are never mutated).
+
+        The version artifact carries only the SMALL rest of the model
+        (config.json + non-table parameter leaves); the giant tables stay
+        in the cold tier and are referenced, not copied."""
+        snapshot = trainer.flush()  # the consistency barrier: before manifest
+        version = self.next_version()
+        manifest = Manifest(
+            version=version,
+            step=int(trainer.state.step),
+            param_hash=param_tree_hash(
+                trainer.state.rest, trainer.state.model_state
+            ),
+            field_size=cfg.model.field_size,
+            feature_size=cfg.model.feature_size,
+            model_name=cfg.model.model_name,
+            created_unix=time.time(),
+            cursor=cursor,
+            watermark=float(watermark),
+            extra={**(extra or {}), "tiered": snapshot},
+        )
+
+        def write_tree(dest: str) -> None:
+            os.makedirs(dest, exist_ok=True)
+            with open(os.path.join(dest, "config.json"), "w") as f:
+                json.dump(cfg.to_dict(), f, indent=2)
+            leaves = jax.tree_util.tree_leaves(
+                (trainer.state.rest, trainer.state.model_state)
+            )
+            arrs = {f"leaf_{i}": np.asarray(x)
+                    for i, x in enumerate(leaves)}
+            with open(os.path.join(dest, "rest_leaves.npz"), "wb") as f:
+                np.savez(f, **arrs)
+
+        return self._publish_artifact(manifest, write_tree)
+
+    def _publish_artifact(self, manifest: Manifest, write_tree) -> Manifest:
+        """Commit one version: ``write_tree(dest_dir)`` produces the
+        artifact locally; remote roots upload it and PUT the manifest
+        LAST (with the orphan-clearing retry discipline), local roots
+        write in place and rename the manifest last."""
+        version = manifest.version
         if is_url(self.root):
             import tempfile
 
@@ -223,7 +284,7 @@ class ModelPublisher:
 
             loc = version_location(self.root, version)
             with tempfile.TemporaryDirectory(prefix="deepfm_publish_") as tmp:
-                export_servable(cfg, state, tmp)
+                write_tree(tmp)
 
                 def _attempt() -> None:
                     # a prior attempt's manifest PUT may have COMMITTED
@@ -254,7 +315,7 @@ class ModelPublisher:
         else:
             dest = version_location(self.root, version)
             shutil.rmtree(dest, ignore_errors=True)  # orphan from a crash
-            export_servable(cfg, state, dest)
+            write_tree(dest)
             path = _manifest_path(self.root, version)
             tmp_path = path + ".tmp"
             with open(tmp_path, "w") as f:

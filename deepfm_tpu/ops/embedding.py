@@ -12,6 +12,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.config import packed_sort_id_bound
+
 # TPU has no native 64-bit integer datapath: int64 index arithmetic runs on
 # an emulated 32-bit-pair representation and int64 gather/scatter indices
 # double the index traffic and can force slower lowerings.  Any vocabulary
@@ -80,14 +82,19 @@ def sort_segments(flat_ids: jnp.ndarray, id_bound: int | None = None):
     int64 packing would silently TRUNCATE with x64 off.)  Without the
     bound, or when it does not fit (e.g. huge-vocab streams), the general
     variadic argsort runs instead — the flagship shape V=117,581 with
-    B_local*F ~= 20k packs exactly (17 + 15 bits)."""
+    B_local*F ~= 20k packs exactly (17 + 15 bits).  The fit test is
+    ``core.config.packed_sort_id_bound`` — ONE definition shared with the
+    config-time validation that warns when a vocab/batch shape would
+    silently demote every dedup sort to the slow path.  Tiered-embedding
+    cache-probe streams (deepfm_tpu/tiered) always fit: their ids are
+    SLOTS bounded by the hot-cache capacity, not the vocabulary."""
     n = flat_ids.shape[0]
     shift = max(1, int(n - 1).bit_length()) if n > 1 else 1
     if (
         flat_ids.dtype == jnp.int32
         and id_bound is not None
         and n > 1
-        and id_bound <= (1 << (32 - shift))
+        and id_bound <= packed_sort_id_bound(n)
     ):
         key = (flat_ids.astype(jnp.uint32) << shift) | jnp.arange(
             n, dtype=jnp.uint32

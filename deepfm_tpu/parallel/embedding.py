@@ -191,6 +191,21 @@ def exchange_plan(
     )
 
 
+def probe_ids(plan: ExchangePlan) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """``(row_id, unique_valid)`` — the plan's deduped unique-id stream.
+
+    This is the CACHE-PROBE KEY STREAM of the tiered embedding store
+    (deepfm_tpu/tiered): one sort yields both the owner routing (this
+    module) and the set of distinct rows a batch needs resident, so a
+    sharded tiered deployment probes its hot cache with exactly the ids
+    the exchange would move — no second dedup pass.  ``row_id`` is valid
+    on the ``unique_valid`` prefix; both are fixed-shape (jit-stable).
+    The huge-vocab regression (tests/test_tiered.py) drives this stream
+    at >= 2**24-row bounds against the packed-sort id_bound contract
+    (ops/embedding.py sort_segments)."""
+    return plan.row_id, plan.unique_valid
+
+
 def _assemble_impl(buf_len, flat_resp, gidx, valid_q, order, seg, scat, ok):
     out = jnp.take(flat_resp, gidx, axis=0)
     mask = valid_q if out.ndim == 1 else valid_q[:, None]

@@ -325,7 +325,10 @@ def make_handler(scorer, model_name: str, reload_status=None,
     """REST handler over any engine exposing score/score_instances —
     the micro-batching engine in production; the single-lock Scorer only
     in the benchmark baseline.  ``GET /v1/metrics`` serves the engine's
-    metrics snapshot when the engine provides one.
+    metrics snapshot when the engine provides one, plus a ``paging``
+    section (hit rate, staged/cold bytes, tier residency) whenever the
+    engine pages weights through tiers (``paging_snapshot`` hook — the
+    tiered giant-vocab scorer, deepfm_tpu/tiered/serving.py).
 
     ``reload_status`` (a zero-arg callable returning the HotSwapper status
     dict, serve/reload.py) turns on hot-reload observability: the status
@@ -380,6 +383,12 @@ def make_handler(scorer, model_name: str, reload_status=None,
                 snap = {"model": model_name, **scorer.metrics_snapshot()}
                 if reload_status is not None:
                     snap["reload"] = reload_status()
+                # tiered engines (deepfm_tpu/tiered TieredScorer — or any
+                # engine paging weights) publish cache hit-rate + paging
+                # gauges; generic hook so every engine shape gets them
+                if "paging" not in snap and hasattr(
+                        scorer, "paging_snapshot"):
+                    snap["paging"] = scorer.paging_snapshot()
                 self._send(200, snap)
             else:
                 self._send(404, {"error": f"unknown path {self.path!r}"})

@@ -1,0 +1,298 @@
+"""Host-side pager: id→slot translation, eviction, writeback, staging.
+
+The pager owns the hot cache's MAP (row → slot) while the device owns its
+DATA.  Per step, between dispatches, it:
+
+1. dedups the batch's id stream (the probe-key stream — the same unique
+   structure PR 5's exchange plan computes on-device for sharded lookups),
+2. probes the slot map: hits are marked used; misses pick victims
+   (free slots first, then approximate-LRU among slots not pinned by this
+   batch),
+3. writes dirty victims back: ONE fixed-shape jitted gather (the
+   designated device→host readback, ``step.make_readback``) pulls their
+   records, which land in the host tier,
+4. fetches miss records from the host tier (which faults pages in from
+   the cold tier),
+5. fills one of two preallocated pinned staging buffers (double-buffered:
+   the buffer the device is still consuming from step N is never the one
+   being filled for step N+1) and returns the translated slot ids + the
+   staged pack for the step's index-update swap.
+
+Everything here is host numpy; the device never sees a global row id.
+:class:`SlotMap` is the bare bookkeeping (probe/victim-select/assign) —
+shared with the serving cache (``serving.py``), which layers no dirty
+tracking on it, so the eviction/pinning algorithm exists exactly once.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .host import HostTier
+from .store import RecordLayout
+
+
+class SlotMap:
+    """Row→slot cache bookkeeping: probe, pinned-LRU victim selection,
+    assignment.  No I/O, no locking — callers (the training pager, the
+    serving cache) hold their own locks and handle writeback/fetch around
+    these primitives.
+
+    The pinning model: ``begin()`` opens a translation epoch; every row
+    probed or assigned in the epoch carries ``slot_use == clock`` and is
+    not evictable until the next epoch — a batch's working set can never
+    evict itself mid-translation."""
+
+    def __init__(self, capacity: int):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._slot_of: dict[int, int] = {}
+        self.slot_row = np.full(self.capacity, -1, np.int64)
+        self.slot_use = np.zeros(self.capacity, np.int64)
+        self._free = list(range(self.capacity - 1, -1, -1))
+        self.clock = 0
+
+    def __len__(self) -> int:
+        return len(self._slot_of)
+
+    def begin(self) -> None:
+        self.clock += 1
+
+    def probe(self, uniq: np.ndarray) -> tuple[np.ndarray, list[int]]:
+        """``(slots, miss_ix)``: per-unique-row slot (-1 for misses, whose
+        positions land in ``miss_ix``); hits are pinned for this epoch."""
+        slots = np.full(uniq.size, -1, np.int64)
+        miss_ix: list[int] = []
+        for j, r in enumerate(uniq):
+            s = self._slot_of.get(int(r))
+            if s is None:
+                miss_ix.append(j)
+            else:
+                slots[j] = s
+                self.slot_use[s] = self.clock
+        return slots, miss_ix
+
+    def select(self, n: int, what: str = "slots") -> np.ndarray:
+        """``n`` reusable slots: the free list first, then approximate-LRU
+        victims among unpinned occupied slots (``argpartition`` on
+        ``slot_use``).  Victims remain MAPPED — the caller inspects
+        ``slot_row[victims]`` (writeback!) then calls :meth:`release`."""
+        take: list[int] = []
+        while self._free and len(take) < n:
+            take.append(self._free.pop())
+        need = n - len(take)
+        if need > 0:
+            cand = np.flatnonzero(
+                (self.slot_row >= 0) & (self.slot_use < self.clock)
+            )
+            if cand.size < need:
+                raise ValueError(
+                    f"cache of {self.capacity} {what} cannot hold one "
+                    f"translation's unique rows (need {need} more "
+                    f"evictable slots, have {cand.size}); raise the "
+                    f"capacity"
+                )
+            if cand.size > need:
+                cand = cand[
+                    np.argpartition(self.slot_use[cand], need - 1)[:need]
+                ]
+            take.extend(int(s) for s in cand)
+        return np.asarray(take[:n], np.int64)
+
+    def release(self, slots: np.ndarray) -> None:
+        """Drop the mappings of the OCCUPIED slots among ``slots`` (after
+        any writeback) so they can be reassigned."""
+        for s in slots:
+            r = int(self.slot_row[s])
+            if r >= 0:
+                del self._slot_of[r]
+                self.slot_row[s] = -1
+
+    def assign(self, slots: np.ndarray, rows: np.ndarray) -> None:
+        for s, r in zip(slots, rows):
+            self._slot_of[int(r)] = int(s)
+            self.slot_row[s] = int(r)
+            self.slot_use[s] = self.clock
+
+    def reset(self) -> None:
+        self._slot_of.clear()
+        self.slot_row[:] = -1
+        self.slot_use[:] = 0
+        self._free = list(range(self.capacity - 1, -1, -1))
+
+
+class DevicePager:
+    def __init__(
+        self,
+        *,
+        capacity: int,
+        layout: RecordLayout,
+        host: HostTier,
+        stage_rows: int,
+        readback_fn,
+        vocab: int,
+    ):
+        if stage_rows < 1:
+            raise ValueError("stage_rows must be >= 1")
+        self.capacity = int(capacity)
+        self.stage_rows = int(stage_rows)
+        self.layout = layout
+        self.host = host
+        self.vocab = int(vocab)
+        self._readback = readback_fn
+        self._map = SlotMap(self.capacity)
+        self._slot_dirty = np.zeros(self.capacity, bool)
+        self._lock = threading.Lock()
+        # double-buffered staging: [2][stage_slots + per-table packs]
+        self._bufs = [self._new_stage_buf() for _ in range(2)]
+        self._buf_ix = 0
+        self._stats = {
+            "probe_ids": 0, "probe_unique": 0, "hits": 0, "misses": 0,
+            "evictions": 0, "writeback_rows": 0, "staged_rows": 0,
+            "stage_bytes": 0, "writeback_bytes": 0, "steps": 0,
+        }
+
+    def _new_stage_buf(self) -> dict:
+        p = self.stage_rows
+        buf: dict = {
+            "slots": np.empty(p, np.int32),
+            "stage": {},
+        }
+        for k, w in self.layout.widths.items():
+            shape = (p,) if w == 1 else (p, w)
+            buf["stage"][k] = {
+                "rows": np.empty(shape, np.float32),
+                "m": np.empty(shape, np.float32),
+                "v": np.empty(shape, np.float32),
+            }
+        return buf
+
+    # -- the per-step probe/translate path ---------------------------------
+    def translate(self, ids: np.ndarray, hot) -> tuple[np.ndarray, dict]:
+        """Translate batch ids to slots, resolving misses.
+
+        ``hot`` is the CURRENT device cache (``PagedState.hot``) — needed
+        to read dirty victims back before their slots are recycled.
+        Returns ``(slot_ids int32, staging)`` where staging carries
+        ``slots`` [P] int32 (sorted, sentinel-padded) and per-table
+        ``rows/m/v`` packs for the step's swap."""
+        with self._lock:
+            return self._translate_locked(ids, hot)
+
+    def _translate_locked(self, ids: np.ndarray, hot):
+        shape = np.asarray(ids).shape
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        np.clip(ids, 0, self.vocab - 1, out=ids)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        self._map.begin()
+        self._stats["steps"] += 1
+        self._stats["probe_ids"] += int(ids.size)
+        self._stats["probe_unique"] += int(uniq.size)
+
+        slots, miss_ix = self._map.probe(uniq)
+        n_miss = len(miss_ix)
+        self._stats["hits"] += int(uniq.size) - n_miss
+        self._stats["misses"] += n_miss
+        if n_miss > self.stage_rows:
+            raise ValueError(
+                f"batch needs {n_miss} staged rows > stage capacity "
+                f"{self.stage_rows}; raise tiered_stage_rows"
+            )
+
+        buf = self._bufs[self._buf_ix]
+        self._buf_ix ^= 1
+        if n_miss:
+            victims = self._take_slots(n_miss, hot)
+            miss_rows = uniq[miss_ix]
+            recs = self.host.get_records(miss_rows)
+            rows, m, v = self.layout.unpack(recs)
+            # sorted staging slots keep the swap a sorted-unique scatter
+            order = np.argsort(victims, kind="stable")
+            sv = victims[order]
+            buf["slots"][:n_miss] = sv
+            for k in self.layout.keys:
+                buf["stage"][k]["rows"][:n_miss] = np.asarray(rows[k])[order]
+                buf["stage"][k]["m"][:n_miss] = np.asarray(m[k])[order]
+                buf["stage"][k]["v"][:n_miss] = np.asarray(v[k])[order]
+            self._map.assign(victims, miss_rows)
+            slots[miss_ix] = victims
+            self._stats["staged_rows"] += n_miss
+            self._stats["stage_bytes"] += (
+                n_miss * self.layout.width * 4
+            )
+        # padding: distinct ascending out-of-range sentinels (dropped by
+        # mode="drop", keep the index vector sorted AND unique)
+        pad = np.arange(self.capacity, self.capacity
+                        + (self.stage_rows - n_miss), dtype=np.int32)
+        buf["slots"][n_miss:] = pad
+        for k in self.layout.keys:
+            for part in buf["stage"][k].values():
+                part[n_miss:] = 0.0
+        # every batch slot will be touched by the lazy update → dirty
+        self._slot_dirty[slots] = True
+        slot_ids = slots[inv].astype(np.int32).reshape(shape)
+        return slot_ids, buf
+
+    def _take_slots(self, n: int, hot) -> np.ndarray:
+        """``n`` reusable slots via the shared :class:`SlotMap` victim
+        selection; dirty victims write back through the designated
+        readback before their mappings drop."""
+        take = self._map.select(n, "hot slots")
+        victims = take[self._map.slot_row[take] >= 0]
+        if victims.size:
+            dirty = victims[self._slot_dirty[victims]]
+            if dirty.size:
+                self._writeback(dirty, hot)
+            self._map.release(victims)
+            self._stats["evictions"] += int(victims.size)
+        return take
+
+    def _writeback(self, slots: np.ndarray, hot) -> None:
+        """Chunked readback of dirty slots into the host tier."""
+        for lo in range(0, slots.size, self.stage_rows):
+            chunk = slots[lo:lo + self.stage_rows]
+            padded = np.full(self.stage_rows, self.capacity, np.int32)
+            padded[:chunk.size] = chunk
+            rows_d, m_d, v_d = self._readback(hot, padded)
+            q = chunk.size
+            recs = self.layout.pack(
+                {k: np.asarray(rows_d[k])[:q] for k in self.layout.keys},
+                {k: np.asarray(m_d[k])[:q] for k in self.layout.keys},
+                {k: np.asarray(v_d[k])[:q] for k in self.layout.keys},
+            )
+            self.host.put_records(self._map.slot_row[chunk], recs)
+            self._stats["writeback_rows"] += q
+            self._stats["writeback_bytes"] += q * self.layout.width * 4
+        self._slot_dirty[slots] = False
+
+    # -- checkpoint / publish barrier --------------------------------------
+    def writeback_all(self, hot) -> int:
+        """Flush EVERY dirty slot to the host tier (cache itself stays
+        warm) — the hot→host leg of the streaming checkpoint/publish
+        flush.  Returns rows written back."""
+        with self._lock:
+            dirty = np.flatnonzero(
+                self._slot_dirty & (self._map.slot_row >= 0)
+            )
+            if dirty.size:
+                self._writeback(dirty, hot)
+            return int(dirty.size)
+
+    def drop_clean(self) -> None:
+        """Forget every (now-clean) mapping — crash-resume starts cache
+        cold by construction; tests use this to force re-faulting."""
+        with self._lock:
+            if self._slot_dirty.any():
+                raise RuntimeError("drop_clean with dirty slots — "
+                                   "writeback_all first")
+            self._map.reset()
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._stats)
+        probed = max(1, out["probe_unique"])
+        out["hit_rate"] = round(out["hits"] / probed, 6)
+        return out

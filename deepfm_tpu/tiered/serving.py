@@ -1,0 +1,240 @@
+"""Read-only tiered serving: score against a paged snapshot.
+
+``TieredScorer`` is the serve-side twin of the trainer's pager: a small
+device-resident row cache (VALUES only — moments never leave the training
+tiers), its own host tier, and a cold tier pinned to a CONSISTENT
+``page_versions`` snapshot (the one the publisher's manifest recorded
+after the trainer's flush barrier), so a live trainer flushing new
+overlays never tears the rows this scorer reads.
+
+Degradation contract (the PR 3 story): the cold tier is the only remote
+dependency.  While it is down, every request touching hot/host-resident
+rows keeps answering — stale-but-serving; only requests forcing a cold
+fault fail (fail-FAST retry policy — serving never stalls a request on a
+dead store), counted in ``paging_snapshot()["cold_errors"]``.  The chaos
+drill (tests/test_tiered_chaos.py) kills the store for 10 s mid
+train+serve and asserts zero failed predicts on resident rows.
+
+Implements the engine protocol ``serve/server.py`` handlers expect
+(``score_instances`` / ``metrics_snapshot``); ``/v1/metrics`` picks up
+the paging gauges through the generic ``paging_snapshot`` hook.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from ..core.config import Config
+from .host import HostTier
+from .pager import SlotMap
+from .step import make_paged_predict
+from .store import ColdTier, RecordLayout
+
+
+class TieredScorer:
+    def __init__(
+        self,
+        cfg: Config,
+        cold: ColdTier,
+        *,
+        rest,
+        model_state,
+        capacity: int = 0,
+        host_rows: int = 0,
+    ):
+        import jax.numpy as jnp
+
+        from .trainer import resolve_tiered
+
+        sizes = resolve_tiered(cfg)
+        self.cfg = cfg
+        self.cold = cold
+        self.capacity = int(capacity or sizes["capacity"])
+        self.host = HostTier(cold, int(host_rows or sizes["host_rows"]))
+        self._rest = rest
+        self._model_state = model_state
+        self._predict = make_paged_predict(cfg)
+        self._lock = threading.Lock()
+        self._map = SlotMap(self.capacity)
+        self._hot = {
+            k: jnp.zeros((self.capacity,) if w == 1 else (self.capacity, w),
+                         jnp.float32)
+            for k, w in cold.layout.widths.items()
+        }
+        self._stats = {
+            "requests": 0, "scored_rows": 0, "hits": 0, "misses": 0,
+            "evictions": 0, "cold_errors": 0, "refill_bytes": 0,
+        }
+
+    @classmethod
+    def from_publish(
+        cls, root: str, staging_dir: str, *, version: int | None = None,
+        cold_root: str | None = None, init_fn=None, retry=None,
+        capacity: int = 0, host_rows: int = 0,
+    ) -> "TieredScorer":
+        """Build a scorer from a ``ModelPublisher.publish_tiered`` version:
+        the manifest's ``extra["tiered"]`` snapshot pins ``page_versions``
+        (consistent reads forever), the version artifact supplies the
+        config + rest params.  ``retry`` should stay fail-fast — serving
+        never stalls a request on a dead cold tier."""
+        import jax
+
+        from ..online import publisher as pub
+        from .trainer import _rest_template, _split_rest
+
+        manifest = (pub.read_manifest(root, version) if version is not None
+                    else pub.latest_manifest(root))
+        if manifest is None:
+            raise FileNotFoundError(f"no committed versions under {root}")
+        snap = manifest.extra.get("tiered")
+        if not snap:
+            raise ValueError(
+                f"version {manifest.version} under {root} is not a tiered "
+                f"publish (no extra['tiered'] snapshot)"
+            )
+        art = pub.fetch_version(root, manifest.version, staging_dir)
+        cfg = Config.from_json(os.path.join(art, "config.json"))
+        template = _rest_template(cfg)
+        rest_t, *_ = _split_rest(cfg, template)
+        tpl = (rest_t, template.model_state)
+        flat, treedef = jax.tree_util.tree_flatten(tpl)
+        with np.load(os.path.join(art, "rest_leaves.npz")) as z:
+            loaded = [z[f"leaf_{i}"] for i in range(len(z.files))]
+        if len(loaded) != len(flat):
+            raise ValueError(
+                f"tiered artifact has {len(loaded)} rest leaves, template "
+                f"expects {len(flat)}"
+            )
+        rest, model_state = jax.tree_util.tree_unflatten(treedef, loaded)
+        layout = RecordLayout(
+            {k: int(w) for k, w in snap["widths"].items()}
+        )
+        cold = ColdTier(
+            cold_root or snap["root"], rows=int(snap["rows"]),
+            layout=layout, page_rows=int(snap["page_rows"]),
+            pages_per_segment=int(snap["pages_per_segment"]),
+            init_fn=init_fn, retry=retry,
+            page_versions={int(p): int(v)
+                           for p, v in snap["page_versions"].items()},
+        )
+        return cls(cfg, cold, rest=rest, model_state=model_state,
+                   capacity=capacity, host_rows=host_rows)
+
+    # -- engine protocol ---------------------------------------------------
+    def score(self, ids: np.ndarray, vals: np.ndarray) -> np.ndarray:
+        """probs [B] for ids/vals [B, F].  Misses fault through
+        host←cold; a dead cold tier fails ONLY the faulting request.
+
+        The instance lock covers the slot-map bookkeeping ONLY — never
+        the cold-tier fault-in (host/cold I/O with its own locking) and
+        never the device dispatch.  A request stalled on a dead cold tier
+        therefore cannot block concurrent hot-resident requests — the
+        stale-but-serving contract the chaos drill measures.  Predict
+        runs on an immutable SNAPSHOT of the hot arrays captured with the
+        slot translation, so a concurrent refill (which rebinds
+        ``self._hot`` to NEW arrays) can never tear an in-flight score."""
+        ids = np.asarray(ids)
+        vals = np.asarray(vals, np.float32)
+        slot_ids, hot = self._translate(ids)
+        with self._lock:
+            self._stats["requests"] += 1
+            self._stats["scored_rows"] += int(ids.shape[0])
+        probs = self._predict(
+            self._rest, self._model_state, hot,
+            {"slot_ids": slot_ids, "feat_vals": vals},
+        )
+        return np.asarray(probs)
+
+    def score_instances(self, instances: list[dict]) -> np.ndarray:
+        from ..serve.batcher import instances_to_arrays
+
+        ids, vals = instances_to_arrays(instances)
+        return self.score(ids, vals)
+
+    # -- paging ------------------------------------------------------------
+    _FAULT_ROUNDS = 4
+
+    def _translate(self, ids: np.ndarray):
+        """``(slot_ids, hot_snapshot)``: probe under the lock, fault
+        misses in OUTSIDE it (host/cold I/O), re-probe and commit.  The
+        probe/commit loop is bounded: a concurrent eviction storm can
+        displace a fetched row before commit, but each round re-fetches
+        only the still-missing remainder (the shared :class:`SlotMap`
+        pins this request's rows for the epoch)."""
+        flat = np.asarray(ids).reshape(-1).astype(np.int64)
+        np.clip(flat, 0, self.cold.rows - 1, out=flat)
+        uniq, inv = np.unique(flat, return_inverse=True)
+        first = True
+        for _ in range(self._FAULT_ROUNDS):
+            with self._lock:
+                if first:
+                    self._map.begin()
+                slots, miss_ix = self._map.probe(uniq)
+                if first:
+                    self._stats["hits"] += uniq.size - len(miss_ix)
+                    self._stats["misses"] += len(miss_ix)
+                    first = False
+                if not miss_ix:
+                    hot = dict(self._hot)
+                    return (slots[inv].astype(np.int32)
+                            .reshape(np.asarray(ids).shape), hot)
+            rows = uniq[miss_ix]
+            try:
+                recs = self.host.get_records(rows)   # I/O: lock NOT held
+            except Exception:
+                with self._lock:
+                    self._stats["cold_errors"] += 1
+                raise
+            r_vals, _, _ = self.cold.layout.unpack(recs)
+            with self._lock:
+                # commit: another request may have resident'ed some rows
+                # meanwhile — probe() refreshes; assign only the gaps
+                now, still = self._map.probe(uniq)
+                fetched = set(miss_ix)
+                gap = [j for j in still if j in fetched]
+                take = self._map.select(len(gap), "serving slots")
+                pos = {j: i for i, j in enumerate(miss_ix)}
+                sel = np.asarray([pos[j] for j in gap], np.int64)
+                self._stats["evictions"] += int(
+                    (self._map.slot_row[take] >= 0).sum())
+                self._map.release(take)
+                # swap via index update: new arrays bind under the
+                # precompiled predict; in-flight scores keep their
+                # snapshots of the OLD (immutable) arrays
+                for k in self._hot:
+                    vals_k = np.asarray(r_vals[k])[sel]
+                    self._hot[k] = self._hot[k].at[take].set(
+                        vals_k, mode="drop"
+                    )
+                self._map.assign(take, uniq[gap])
+                self._stats["refill_bytes"] += int(
+                    len(gap) * self.cold.layout.width * 4)
+        raise RuntimeError(
+            f"slot translation did not converge in {self._FAULT_ROUNDS} "
+            f"rounds — serving cache of {self.capacity} slots is thrashing "
+            f"under concurrent requests; raise capacity"
+        )
+
+    def warm(self, ids) -> None:
+        """Pre-resident rows (the drill warms the serve set before the
+        outage; production warms from the id stream's head)."""
+        flat = np.unique(np.asarray(ids).reshape(-1))
+        self._translate(flat.reshape(1, -1))
+
+    # -- metrics -----------------------------------------------------------
+    def paging_snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self._stats)
+            probed = max(1, out["hits"] + out["misses"])
+            out["hit_rate"] = round(out["hits"] / probed, 6)
+            out["resident_slots"] = len(self._map)
+        out["host"] = self.host.stats()
+        out["cold"] = self.cold.stats()
+        return out
+
+    def metrics_snapshot(self) -> dict:
+        snap = self.paging_snapshot()
+        return {"requests": snap["requests"], "paging": snap}

@@ -1,0 +1,379 @@
+"""Cold tier: object-store row pages with ranged reads + COW overlays.
+
+The cold tier is the system of record for every row the hot/host tiers do
+not hold.  Rows are grouped into fixed-size **pages** (``page_rows`` rows
+of ``RecordLayout.width`` f32s each); pages are stored two ways:
+
+* **base segments** — immutable bulk objects of ``pages_per_segment``
+  pages each (``segments/<seg>.bin``), written once by
+  :meth:`ColdTier.import_dense` (or a bulk-import job).  A page read
+  fetches ONLY its byte span via an HTTP ``Range`` GET
+  (``HttpObjectStore.get_range``) — never the whole segment, which at
+  north-star scale is tens of MB of other rows.
+* **page overlays** — copy-on-write objects ``pages/<page>.v<ver>.bin``
+  holding dirty pages written back from the host tier.  ``page_versions``
+  maps page → committed overlay version; a reader holding a snapshot of
+  that map sees a CONSISTENT table no matter what the writer flushes
+  afterwards — the property the online publisher's manifest records
+  (``snapshot()``).
+
+Pages absent from both (a giant table nobody ever wrote) materialize from
+``init_fn(page) -> [rows, width]`` — the virtual-initializer trick that
+lets a 100M-row table exist without 40 GB of objects; only touched pages
+ever hit storage.
+
+Every remote byte moves through ``HttpObjectStore`` and therefore under
+its ``RetryPolicy`` (PR 3): the trainer installs a patient policy so a
+cold-tier outage stalls paging (and training) until the store heals,
+while serving keeps a fail-fast policy and keeps answering from resident
+rows.  All reads/writes are accounted in ``stats()`` — the paging
+bandwidth the large-vocab bench curves come from.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+
+from ..data.object_store import HttpObjectStore, is_url, join_url
+
+# a cold read slower than this counts its excess toward ``stall_secs`` —
+# the stalls-then-resumes signal the chaos drill asserts on
+_STALL_BUDGET_SECS = 0.5
+
+_ITEM = np.dtype(np.float32).itemsize
+
+
+class RecordLayout:
+    """Per-row record: ``[value | m | v]`` per table, tables concatenated.
+
+    One record carries a row of EVERY lazy table plus both Adam moments,
+    so a single page fetch (and a single writeback) services the whole
+    co-evicted unit — the reason rows and moments can share one paging
+    decision.  ``widths`` maps table name → row width (fm_w: 1, fm_v: K)
+    in a fixed iteration order shared by every tier.
+    """
+
+    def __init__(self, widths: dict[str, int]):
+        if not widths:
+            raise ValueError("RecordLayout needs at least one table")
+        self.widths = dict(widths)
+        self.keys = tuple(widths)
+        self._off: dict[str, int] = {}
+        off = 0
+        for k, w in widths.items():
+            self._off[k] = off
+            off += 3 * int(w)
+        self.width = off  # floats per row record
+
+    def value_slice(self, key: str) -> slice:
+        """Columns holding table ``key``'s row VALUE (serving reads only
+        values; moments ride along for training)."""
+        o, w = self._off[key], self.widths[key]
+        return slice(o, o + w)
+
+    def moment_slices(self, key: str) -> tuple[slice, slice]:
+        o, w = self._off[key], self.widths[key]
+        return slice(o + w, o + 2 * w), slice(o + 2 * w, o + 3 * w)
+
+    def pack(self, rows: dict, m: dict, v: dict) -> np.ndarray:
+        """dicts of [n(, w)] arrays -> [n, width] records."""
+        n = np.asarray(rows[self.keys[0]]).shape[0]
+        out = np.empty((n, self.width), np.float32)
+        for k in self.keys:
+            w = self.widths[k]
+            for sl, src in zip(
+                (self.value_slice(k), *self.moment_slices(k)),
+                (rows[k], m[k], v[k]),
+            ):
+                out[:, sl] = np.asarray(src, np.float32).reshape(n, w)
+        return out
+
+    def unpack(self, recs: np.ndarray) -> tuple[dict, dict, dict]:
+        """[n, width] records -> (rows, m, v) dicts shaped like the tables
+        ([n] for width-1 tables, [n, w] otherwise)."""
+        rows, m, v = {}, {}, {}
+        for k in self.keys:
+            w = self.widths[k]
+            msl, vsl = self.moment_slices(k)
+            parts = [recs[:, self.value_slice(k)], recs[:, msl], recs[:, vsl]]
+            if w == 1:
+                parts = [a[:, 0] for a in parts]
+            rows[k], m[k], v[k] = parts
+        return rows, m, v
+
+
+class ColdTier:
+    """Page-granular row storage on a directory or object-store prefix."""
+
+    def __init__(
+        self,
+        root: str,
+        *,
+        rows: int,
+        layout: RecordLayout,
+        page_rows: int = 1024,
+        pages_per_segment: int = 64,
+        init_fn=None,
+        retry=None,
+        page_versions: dict[int, int] | None = None,
+    ):
+        if page_rows < 1 or pages_per_segment < 1:
+            raise ValueError("page_rows and pages_per_segment must be >= 1")
+        self.root = root.rstrip("/")
+        self.rows = int(rows)
+        self.layout = layout
+        self.page_rows = int(page_rows)
+        self.pages_per_segment = int(pages_per_segment)
+        self.num_pages = -(-self.rows // self.page_rows)
+        self._init_fn = init_fn
+        self._remote = is_url(root)
+        self._store = HttpObjectStore(retry=retry) if self._remote else None
+        self._lock = threading.Lock()
+        self._page_versions: dict[int, int] = dict(page_versions or {})
+        self._superseded: dict[int, list[int]] = {}
+        self._next_version = 1 + max(self._page_versions.values(), default=0)
+        self._seg_exists: dict[int, bool] = {}
+        self._stats = {
+            "cold_reads": 0, "cold_read_bytes": 0, "cold_read_secs": 0.0,
+            "cold_writes": 0, "cold_write_bytes": 0, "init_pages": 0,
+            "stall_secs": 0.0,
+        }
+
+    # -- keys --------------------------------------------------------------
+    def _seg_key(self, seg: int) -> str:
+        name = f"segments/{seg:06d}.bin"
+        return (join_url(self.root, name) if self._remote
+                else os.path.join(self.root, *name.split("/")))
+
+    def _page_key(self, page: int, version: int) -> str:
+        name = f"pages/{page:08d}.v{version:06d}.bin"
+        return (join_url(self.root, name) if self._remote
+                else os.path.join(self.root, *name.split("/")))
+
+    def page_len(self, page: int) -> int:
+        return min(self.page_rows, self.rows - page * self.page_rows)
+
+    # -- read --------------------------------------------------------------
+    def read_page(self, page: int) -> np.ndarray:
+        """One page's records ``[page_len, width]``: committed overlay if
+        any, else a ranged read of its base-segment span, else the virtual
+        initializer."""
+        if not 0 <= page < self.num_pages:
+            raise IndexError(f"page {page} out of range [0, {self.num_pages})")
+        eff = self.page_len(page)
+        nbytes = eff * self.layout.width * _ITEM
+        with self._lock:
+            version = self._page_versions.get(page)
+        t0 = time.monotonic()
+        data = None
+        if version is not None:
+            data = self._read_object(self._page_key(page, version))
+        else:
+            seg = page // self.pages_per_segment
+            if self._segment_exists(seg):
+                off = (page % self.pages_per_segment) \
+                    * self.page_rows * self.layout.width * _ITEM
+                data = self._read_range(self._seg_key(seg), off, nbytes)
+        elapsed = time.monotonic() - t0
+        if data is None:
+            if self._init_fn is None:
+                raise KeyError(
+                    f"page {page} has no overlay, no base segment, and no "
+                    f"init_fn under {self.root}"
+                )
+            arr = np.asarray(self._init_fn(page), np.float32)
+            if arr.shape != (eff, self.layout.width):
+                raise ValueError(
+                    f"init_fn(page={page}) returned {arr.shape}, expected "
+                    f"{(eff, self.layout.width)}"
+                )
+            with self._lock:
+                self._stats["init_pages"] += 1
+            return arr
+        with self._lock:
+            self._stats["cold_reads"] += 1
+            self._stats["cold_read_bytes"] += len(data)
+            self._stats["cold_read_secs"] += elapsed
+            if elapsed > _STALL_BUDGET_SECS:
+                self._stats["stall_secs"] += elapsed - _STALL_BUDGET_SECS
+        return np.frombuffer(data, np.float32).reshape(
+            eff, self.layout.width
+        ).copy()
+
+    def _segment_exists(self, seg: int) -> bool:
+        with self._lock:
+            cached = self._seg_exists.get(seg)
+        if cached is not None:
+            return cached
+        key = self._seg_key(seg)
+        found = (self._store.exists(key) if self._remote
+                 else os.path.isfile(key))
+        with self._lock:
+            # only a positive probe is cached: a segment published later
+            # (bulk import racing readers) must stay discoverable
+            if found:
+                self._seg_exists[seg] = True
+        return found
+
+    def _read_object(self, key: str) -> bytes:
+        if self._remote:
+            return self._store.get(key)
+        with open(key, "rb") as f:
+            return f.read()
+
+    def _read_range(self, key: str, offset: int, length: int) -> bytes:
+        if self._remote:
+            return self._store.get_range(key, offset, length)
+        with open(key, "rb") as f:
+            f.seek(offset)
+            return f.read(length)
+
+    # -- write -------------------------------------------------------------
+    def write_page(self, page: int, recs: np.ndarray) -> int:
+        """Commit a dirty page as a NEW overlay version (copy-on-write —
+        readers pinned to an older ``page_versions`` snapshot keep seeing
+        their version).  Returns the committed version."""
+        eff = self.page_len(page)
+        recs = np.ascontiguousarray(recs, np.float32)
+        if recs.shape != (eff, self.layout.width):
+            raise ValueError(
+                f"page {page} write has shape {recs.shape}, expected "
+                f"{(eff, self.layout.width)}"
+            )
+        with self._lock:
+            version = self._next_version
+            self._next_version += 1
+            if page in self._superseded:
+                self._superseded[page].append(self._page_versions[page])
+            elif page in self._page_versions:
+                self._superseded[page] = [self._page_versions[page]]
+        data = recs.tobytes()
+        key = self._page_key(page, version)
+        if self._remote:
+            self._store.put(key, data)
+        else:
+            os.makedirs(os.path.dirname(key), exist_ok=True)
+            tmp = key + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, key)
+        with self._lock:
+            self._page_versions[page] = version
+            self._stats["cold_writes"] += 1
+            self._stats["cold_write_bytes"] += len(data)
+        # NOTE: the superseded overlay is NOT deleted here — copy-on-write
+        # is the consistency mechanism: a publisher manifest or paged
+        # checkpoint pinning the old page_versions must keep reading the
+        # old object.  Space is reclaimed explicitly via gc_overlays().
+        return version
+
+    def gc_overlays(self, pinned: list[dict] | None = None) -> int:
+        """Delete superseded overlay objects not referenced by the LIVE
+        map nor by any ``pinned`` snapshot (``snapshot()`` dicts from
+        still-readable manifests/checkpoints).  Explicit — never called on
+        the write path — so retention policy stays with the caller (the
+        publisher's keep-window, the checkpoint's keep count).  Returns
+        objects deleted; failures are skipped (an orphan costs space,
+        never correctness)."""
+        keep: set[tuple[int, int]] = set()
+        for snap in pinned or []:
+            for p, ver in snap.get("page_versions", {}).items():
+                keep.add((int(p), int(ver)))
+        with self._lock:
+            keep.update(
+                (p, ver) for p, ver in self._page_versions.items()
+            )
+            doomed = [
+                (p, ver)
+                for p, vers in self._superseded.items()
+                for ver in vers if (p, ver) not in keep
+            ]
+            self._superseded = {}
+        deleted = 0
+        for p, ver in doomed:
+            try:
+                if self._remote:
+                    self._store.delete(self._page_key(p, ver))
+                else:
+                    os.remove(self._page_key(p, ver))
+                deleted += 1
+            except OSError:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "cold tier: could not gc overlay page=%d v=%d", p, ver,
+                )
+        return deleted
+
+    # -- bulk import / export ----------------------------------------------
+    def import_dense(self, rows: dict, m: dict, v: dict) -> int:
+        """Write a fully-materialized table (+moments) as BASE SEGMENTS —
+        the bulk-ingest path (and the parity tests' seed), exercising the
+        ranged-read format end to end.  Returns segments written."""
+        seg_rows = self.page_rows * self.pages_per_segment
+        n_segs = -(-self.rows // seg_rows)
+        for seg in range(n_segs):
+            lo = seg * seg_rows
+            hi = min(self.rows, lo + seg_rows)
+            recs = self.layout.pack(
+                {k: np.asarray(rows[k])[lo:hi] for k in self.layout.keys},
+                {k: np.asarray(m[k])[lo:hi] for k in self.layout.keys},
+                {k: np.asarray(v[k])[lo:hi] for k in self.layout.keys},
+            )
+            data = np.ascontiguousarray(recs).tobytes()
+            key = self._seg_key(seg)
+            if self._remote:
+                self._store.put(key, data)
+            else:
+                os.makedirs(os.path.dirname(key), exist_ok=True)
+                tmp = key + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, key)
+            with self._lock:
+                self._seg_exists[seg] = True
+        return n_segs
+
+    def export_dense(self) -> tuple[dict, dict, dict]:
+        """Materialize the whole logical table (+moments) — SMALL vocabs
+        only (parity tests); the point of this package is that production
+        tables never do this."""
+        rows = {k: np.empty(
+            (self.rows,) if w == 1 else (self.rows, w), np.float32)
+            for k, w in self.layout.widths.items()}
+        m = {k: np.empty_like(a) for k, a in rows.items()}
+        v = {k: np.empty_like(a) for k, a in rows.items()}
+        for page in range(self.num_pages):
+            lo = page * self.page_rows
+            pr, pm, pv = self.layout.unpack(self.read_page(page))
+            for k in self.layout.keys:
+                rows[k][lo:lo + self.page_len(page)] = pr[k]
+                m[k][lo:lo + self.page_len(page)] = pm[k]
+                v[k][lo:lo + self.page_len(page)] = pv[k]
+        return rows, m, v
+
+    # -- snapshot / stats --------------------------------------------------
+    def snapshot(self) -> dict:
+        """Consistent-read descriptor: everything a reader needs to see
+        exactly the rows committed so far (the publisher manifests this;
+        the paged checkpoint persists it)."""
+        with self._lock:
+            return {
+                "root": self.root,
+                "rows": self.rows,
+                "page_rows": self.page_rows,
+                "pages_per_segment": self.pages_per_segment,
+                "widths": dict(self.layout.widths),
+                "page_versions": {
+                    str(p): int(ver)
+                    for p, ver in self._page_versions.items()
+                },
+            }
+
+    def stats(self) -> dict:
+        with self._lock:
+            return dict(self._stats)

@@ -60,6 +60,35 @@ def segment_rows(flat_ids: jnp.ndarray, flat_grads: jnp.ndarray,
     return row_id, summed, valid
 
 
+def adam_row_math(
+    p_r: jnp.ndarray,
+    m_r: jnp.ndarray,
+    v_r: jnp.ndarray,
+    gsum: jnp.ndarray,
+    step: jnp.ndarray,
+    cfg: OptimizerConfig,
+    *,
+    learning_rate: float,
+    l2_reg: float = 0.0,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The per-row Adam arithmetic on gathered rows [N, W]: lazy-L2 fold,
+    moment update, bias correction, parameter step.  ONE implementation
+    shared by the dense-id update, the shard-local update, and the tiered
+    hot-cache (slot-space) step — bit-parity between those paths
+    (tests/test_tiered.py) holds because they run THIS function on the
+    same values.  Returns (new_p, new_m, new_v) for the gathered rows."""
+    if l2_reg:
+        gsum = gsum + l2_reg * p_r
+    b1, b2, eps = cfg.adam_b1, cfg.adam_b2, cfg.adam_eps
+    m_n = b1 * m_r + (1.0 - b1) * gsum
+    v_n = b2 * v_r + (1.0 - b2) * jnp.square(gsum)
+    t = step.astype(jnp.float32)
+    m_hat = m_n / (1.0 - jnp.power(b1, t))
+    v_hat = v_n / (1.0 - jnp.power(b2, t))
+    p_n = p_r - learning_rate * m_hat / (jnp.sqrt(v_hat) + eps)
+    return p_n, m_n, v_n
+
+
 def lazy_adam_update(
     table: jnp.ndarray,
     m: jnp.ndarray,
@@ -100,18 +129,14 @@ def lazy_adam_update(
         )
 
     p_r = t2[row_id]
-    # dense-L2 analog on touched rows, once per unique row
-    if l2_reg:
-        gsum = gsum + l2_reg * p_r
     m_r = m2[row_id]
     v_r = v2[row_id]
-    b1, b2, eps = cfg.adam_b1, cfg.adam_b2, cfg.adam_eps
-    m_n = b1 * m_r + (1.0 - b1) * gsum
-    v_n = b2 * v_r + (1.0 - b2) * jnp.square(gsum)
-    t = step.astype(jnp.float32)
-    m_hat = m_n / (1.0 - jnp.power(b1, t))
-    v_hat = v_n / (1.0 - jnp.power(b2, t))
-    p_n = p_r - learning_rate * m_hat / (jnp.sqrt(v_hat) + eps)
+    # dense-L2 analog on touched rows, once per unique row (inside
+    # adam_row_math); one shared implementation of the per-row arithmetic
+    p_n, m_n, v_n = adam_row_math(
+        p_r, m_r, v_r, gsum, step, cfg,
+        learning_rate=learning_rate, l2_reg=l2_reg,
+    )
 
     # padding segments get strictly-increasing OUT-OF-BOUNDS ids: XLA drops
     # them, and the index vector stays sorted and duplicate-free so the
@@ -165,17 +190,12 @@ def lazy_adam_update_shard(
     in_range = valid & (local_id >= 0) & (local_id < rows)
     safe = jnp.clip(local_id, 0, rows - 1)
     p_r = t2[safe]
-    if l2_reg:
-        g2 = g2 + l2_reg * p_r
     m_r = m2[safe]
     v_r = v2[safe]
-    b1, b2, eps = cfg.adam_b1, cfg.adam_b2, cfg.adam_eps
-    m_n = b1 * m_r + (1.0 - b1) * g2
-    v_n = b2 * v_r + (1.0 - b2) * jnp.square(g2)
-    t = step.astype(jnp.float32)
-    m_hat = m_n / (1.0 - jnp.power(b1, t))
-    v_hat = v_n / (1.0 - jnp.power(b2, t))
-    p_n = p_r - learning_rate * m_hat / (jnp.sqrt(v_hat) + eps)
+    p_n, m_n, v_n = adam_row_math(
+        p_r, m_r, v_r, g2, step, cfg,
+        learning_rate=learning_rate, l2_reg=l2_reg,
+    )
 
     n = row_id.shape[0]
     scatter_id = jnp.where(

@@ -275,6 +275,8 @@ def run_eval(cfg: Config, ctx: SPMDContext, state: TrainState, log: MetricLogger
 
 def run_train(cfg: Config) -> TrainState:
     """TRAIN task: resume-or-init, epoch loop, periodic ckpt, final eval+export."""
+    if cfg.model.tiered_embeddings:
+        return run_train_tiered(cfg)
     # Handlers install BEFORE setup: a spot/maintenance SIGTERM is likeliest
     # during the expensive create/compile/restore phase of a big job, and
     # before round 4 it hit the default handler there (uncaught kill, no
@@ -283,6 +285,89 @@ def run_train(cfg: Config) -> TrainState:
     # state, and raises PreemptedError like a mid-loop one.
     with PreemptionGuard() as guard:
         return _run_train_guarded(cfg, guard)
+
+
+def run_train_tiered(cfg: Config):
+    """TRAIN task, tiered giant-vocab mode (``model.tiered_embeddings``):
+    the table pages through the HBM←host←object-store tiers
+    (deepfm_tpu/tiered) instead of living resident.  Single-controller:
+    the hot cache is one device's budget (row-sharding a paged cache is
+    the ROADMAP's distributed-serving follow-on).
+
+    Same rhythm as the resident loop — resume-or-init, epoch feed with
+    the id-stream prefetch observer, periodic STREAMING paged
+    checkpoints, preemption-safe save — and a final ``publish_tiered``
+    (consistent cold-tier snapshot in the manifest) when a servable dir
+    is configured.  Returns the final ``PagedState``."""
+    if jax.process_count() > 1 or cfg.mesh.model_parallel > 1:
+        raise RuntimeError(
+            "tiered embeddings are single-process, model_parallel=1 "
+            "(the hot cache lives on one device); drop the mesh flags "
+            "or use the resident row-sharded path"
+        )
+    from ..tiered import TieredTrainer
+
+    log = MetricLogger(log_steps=cfg.run.log_steps)
+    maybe_clear(cfg.run.model_dir, cfg.run.clear_existing_model)
+    ckpt_dir = os.path.join(cfg.run.model_dir, "tiered_ckpt")
+    cold_root = cfg.model.tiered_cold_url or os.path.join(
+        cfg.run.model_dir, "cold"
+    )
+    with PreemptionGuard() as guard:
+        if os.path.exists(os.path.join(ckpt_dir, "tiered_meta.json")):
+            trainer = TieredTrainer.restore(cfg, ckpt_dir, virtual=True)
+            log.event("resume", step=int(trainer.state.step))
+        else:
+            trainer = TieredTrainer.create_virtual(cfg, cold_root)
+        step = int(trainer.state.step)
+        log.seed_step(step)
+        topo = worker_topology(cfg)
+        batches = make_input_pipeline(
+            cfg.data,
+            topo,
+            field_size=cfg.model.field_size,
+            channel=cfg.data.training_channel_name,
+            data_dir=cfg.data.training_data_dir,
+            feature_size=cfg.model.feature_size,
+            seed=cfg.run.seed,
+            skip_batches=step,
+        )
+        # the observer IS the cold→host prefetch: this feed sees batches
+        # prefetch_batches ahead of the step consuming them
+        feed = DevicePrefetcher(
+            batches, lambda b: b, depth=cfg.data.prefetch_batches,
+            observer=trainer.observer(),
+        )
+        ckpt_every = cfg.run.checkpoint_every_steps
+        with feed:
+            for batch in feed:
+                if guard.should_stop:
+                    break
+                metrics = trainer.train_batch(batch)
+                step += 1
+                log.step(step, int(batch["label"].shape[0]), metrics)
+                if ckpt_every and step % ckpt_every == 0:
+                    trainer.save(ckpt_dir)
+        trainer.save(ckpt_dir)
+        if guard.should_stop:
+            log.event("preempted", step=step)
+            trainer.close()
+            raise PreemptedError(f"preempted at step {step}")
+        if cfg.run.servable_model_dir:
+            from ..online.publisher import ModelPublisher
+
+            manifest = ModelPublisher(
+                cfg.run.servable_model_dir,
+                keep=cfg.run.keep_checkpoints,
+            ).publish_tiered(cfg, trainer)
+            log.event("publish_tiered", version=manifest.version,
+                      step=manifest.step)
+        paging = trainer.paging_snapshot()
+        log.event("tiered_done", step=step,
+                  hit_rate=paging["pager"]["hit_rate"])
+        state = trainer.state
+        trainer.close()
+        return state
 
 
 def _run_train_guarded(cfg: Config, guard: PreemptionGuard) -> TrainState:

@@ -220,22 +220,39 @@ def _make_handler(root: str, max_keys: int, plan: FaultPlan):
             path = self._path_for(parsed.path)
             if path is None or not os.path.isfile(path):
                 return self._send(404, b"no such key", "text/plain")
-            with open(path, "rb") as f:
-                data = f.read()
+            size = os.path.getsize(path)
             cut = None
             if rule is not None and 0.0 < rule.truncate < 1.0:
                 cut = rule.truncate
             rng = self.headers.get("Range")
             if rng and rng.startswith("bytes="):
+                # partial read: SEEK to the span, never load the whole
+                # object — cold-tier row pages ride this path against
+                # multi-GB segments.  Fault rules (status/delay/drop
+                # handled above, truncate below) apply to ranged reads
+                # exactly as to full GETs.
                 spec = rng[len("bytes="):]
                 start_s, _, end_s = spec.partition("-")
-                start = int(start_s) if start_s else 0
-                end = int(end_s) if end_s else len(data) - 1
-                part = data[start:end + 1]
+                if not start_s:  # suffix form "bytes=-N": last N bytes
+                    start = max(0, size - int(end_s or 0))
+                    end = size - 1
+                else:
+                    start = int(start_s)
+                    end = min(int(end_s), size - 1) if end_s else size - 1
+                if start >= size or end < start:
+                    self.send_response(416)
+                    self.send_header("Content-Range", f"bytes */{size}")
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                part_len = end - start + 1
+                with open(path, "rb") as f:
+                    f.seek(start)
+                    part = f.read(part_len)
                 self.send_response(206)
                 self.send_header("Content-Type", "application/octet-stream")
                 self.send_header(
-                    "Content-Range", f"bytes {start}-{end}/{len(data)}")
+                    "Content-Range", f"bytes {start}-{end}/{size}")
                 self.send_header("Content-Length", str(len(part)))
                 self.end_headers()
                 if cut is not None:
@@ -245,6 +262,8 @@ def _make_handler(root: str, max_keys: int, plan: FaultPlan):
                     return
                 self.wfile.write(part)
                 return
+            with open(path, "rb") as f:
+                data = f.read()
             if cut is not None:
                 self.send_response(200)
                 self.send_header("Content-Type", "application/octet-stream")

@@ -16,7 +16,11 @@ fi
 
 # the trace audit's collective contract lowers the sharded train step on an
 # 8-device virtual CPU mesh (the CLI also arranges this itself when
-# JAX_PLATFORMS=cpu; exported here so the gate never silently degrades)
+# JAX_PLATFORMS=cpu; exported here so the gate never silently degrades).
+# The same run enforces the PAGING contract (audit_paged_step): the tiered
+# store's steady-state step must lower with no host transfers outside the
+# designated staging arguments — seeded violations in tests/test_analysis.py
+# prove a smuggled transfer is caught.
 exec env JAX_PLATFORMS=cpu \
     XLA_FLAGS="${XLA_FLAGS:+$XLA_FLAGS }--xla_force_host_platform_device_count=8" \
     python -m deepfm_tpu.analysis deepfm_tpu \

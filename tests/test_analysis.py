@@ -1041,3 +1041,87 @@ class TestSeededViolationsEndToEnd:
 
 if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-q"]))
+
+
+class TestPagingContract:
+    """The tiered store's paging trace-audit contract
+    (trace_audit.audit_paged_step, wired into scripts/check.sh via
+    run_trace_audit): the lowered steady-state slot-space step contains
+    no host transfers outside the designated staging arguments."""
+
+    def test_real_paged_step_holds_the_contract(self):
+        from deepfm_tpu.analysis.trace_audit import audit_paged_step
+
+        findings = audit_paged_step()
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_smuggled_host_read_caught(self):
+        """A step that sneaks a device->host transfer (concretizing a
+        traced value) must be caught by the transfer contract."""
+        import jax
+        import jax.numpy as jnp
+
+        from deepfm_tpu.analysis.trace_audit import audit_paged_step
+        from deepfm_tpu.tiered.step import make_paged_train_step
+
+        def smuggling_builder(cfg, capacity):
+            real = make_paged_train_step(cfg, capacity, donate=False)
+
+            def step(state, batch, stage_slots, stage):
+                # the sneak: host-reads the traced slot stream
+                if int(jnp.sum(batch["slot_ids"])) >= 0:
+                    pass
+                return real(state, batch, stage_slots, stage)
+
+            return jax.jit(step)
+
+        findings = audit_paged_step(step_builder=smuggling_builder)
+        assert any(f.rule == "trace-transfer" for f in findings), findings
+
+    def test_baked_staging_pack_caught(self):
+        """A step that drops the staging arguments and bakes concrete
+        staged rows into the executable is an undeclared per-step host
+        transfer — convicted by the leaf-count contract."""
+        import jax
+        import jax.numpy as jnp
+
+        from deepfm_tpu.analysis.trace_audit import (
+            _PAGED_STAGE,
+            audit_paged_step,
+        )
+        from deepfm_tpu.tiered.step import make_paged_train_step
+        from deepfm_tpu.tiered.trainer import (
+            _rest_template,
+            _split_rest,
+            _widths,
+        )
+
+        def baked_builder(cfg, capacity):
+            real = make_paged_train_step(cfg, capacity, donate=False)
+            template = _rest_template(cfg)
+            _, _, _, _, keys = _split_rest(cfg, template)
+            widths = _widths(cfg, keys)
+            p = _PAGED_STAGE
+            slots = jnp.arange(p, dtype=jnp.int32)
+            stage = {k: {part: jnp.zeros(
+                (p,) if w == 1 else (p, w), jnp.float32)
+                for part in ("rows", "m", "v")}
+                for k, w in widths.items()}
+
+            def step(state, batch):
+                return real(state, batch, slots, stage)
+
+            return jax.jit(step)
+
+        findings = audit_paged_step(step_builder=baked_builder)
+        assert any(f.rule == "trace-transfer"
+                   and "baked" in f.message for f in findings), findings
+
+    def test_undonated_paged_step_caught(self):
+        from deepfm_tpu.analysis.trace_audit import audit_paged_step
+        from deepfm_tpu.tiered.step import make_paged_train_step
+
+        findings = audit_paged_step(
+            step_builder=lambda c, cap: make_paged_train_step(
+                c, cap, donate=False))
+        assert any(f.rule == "trace-donation" for f in findings), findings

@@ -386,3 +386,73 @@ def test_fifo_bridge_resumes_dropped_stream(tmp_path):
     finally:
         server.shutdown()
         server.server_close()
+
+
+# -- ranged reads (the cold-tier row-page path, deepfm_tpu/tiered) ----------
+
+@pytest.fixture()
+def faulty_store_env(tmp_path):
+    """Like store_env but exposes the server (and its FaultPlan) too."""
+    root = tmp_path / "store_root"
+    (root / "bucket").mkdir(parents=True)
+    server, base = serve(str(root))
+    from deepfm_tpu.utils.retry import RetryPolicy
+
+    store = HttpObjectStore(timeout=10, retry=RetryPolicy(
+        max_attempts=5, base_delay_secs=0.0, max_delay_secs=0.0,
+        sleep=lambda s: None))
+    yield server, base, store
+    server.shutdown()
+    server.server_close()
+
+
+def test_get_range_span_semantics(faulty_store_env):
+    _, base, store = faulty_store_env
+    url = f"{base}/bucket/seg.bin"
+    payload = bytes(range(256))
+    store.put(url, payload)
+    assert store.get_range(url, 0, 16) == payload[:16]
+    assert store.get_range(url, 100, 56) == payload[100:156]
+    # span overrunning the object: short read is legitimate, not an error
+    assert store.get_range(url, 250, 100) == payload[250:]
+    assert store.get_range(url, 10, 0) == b""
+    # a span entirely past the end: empty (dev server answers 416)
+    with pytest.raises(ObjectStoreError) as ei:
+        store.get_range(url, 1000, 10)
+    assert not ei.value.retryable or ei.value.status == 416
+
+
+def test_get_range_fault_rules_apply_to_ranged_reads(faulty_store_env):
+    """FaultPlan latency/truncation rules fire on Range GETs exactly as
+    on full GETs; mid-span truncation is VERIFIED against the response
+    headers and retried instead of silently returning short bytes."""
+    server, base, store = faulty_store_env
+    url = f"{base}/bucket/seg.bin"
+    payload = bytes(range(200)) * 5
+    store.put(url, payload)
+    server.fault_plan.add(verb="GET", key="bucket/seg.bin", times=3,
+                          truncate=0.4)
+    assert store.get_range(url, 64, 512) == payload[64:576]
+    fired = server.fault_plan.to_dict()["rules"][0]["fired"]
+    assert fired == 3  # three truncated attempts, verified + retried
+    # status faults ride the same retry classification
+    server.fault_plan.clear()
+    server.fault_plan.add(verb="GET", key="bucket/seg.bin", times=2,
+                          status=503)
+    assert store.get_range(url, 0, 64) == payload[:64]
+    # fail-fast on a permanent error: 404 never retries
+    with pytest.raises(ObjectStoreError) as ei:
+        store.get_range(f"{base}/bucket/missing.bin", 0, 8)
+    assert ei.value.status == 404 and not ei.value.retryable
+
+
+def test_open_read_offset_length(faulty_store_env):
+    _, base, store = faulty_store_env
+    url = f"{base}/bucket/seg.bin"
+    payload = bytes(range(256)) * 2
+    store.put(url, payload)
+    with store.open_read(url, offset=32, length=64) as r:
+        assert r.read() == payload[32:96]
+    # suffix form via plain offset keeps working
+    with store.open_read(url, offset=500) as r:
+        assert r.read() == payload[500:]

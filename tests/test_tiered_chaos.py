@@ -1,0 +1,139 @@
+"""Chaos drill: cold-tier outage mid train+serve (ISSUE 6 / PR 3 story).
+
+Kills the object-store cold tier for 10 seconds while a tiered trainer
+is paging rows in every step and a tiered scorer is serving predictions:
+
+* training STALLS on its prefetch misses (the patient retry policy keeps
+  re-attempting the ranged page reads) and RESUMES when the store heals
+  — zero steps lost, never a crash;
+* serving keeps answering from hot/host-resident rows the whole time —
+  stale-but-serving, ZERO failed predicts.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepfm_tpu.core.config import Config
+from deepfm_tpu.online.publisher import ModelPublisher
+from deepfm_tpu.tiered import TieredScorer, TieredTrainer
+from deepfm_tpu.train.step import create_train_state
+from deepfm_tpu.utils.dev_object_store import serve
+from deepfm_tpu.utils.retry import RetryPolicy
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+V, F, K, B = 8192, 8, 8, 32
+OUTAGE_SECS = 10.0
+
+
+def _cfg() -> Config:
+    return Config.from_dict({
+        "model": {
+            "feature_size": V, "field_size": F, "embedding_size": K,
+            "deep_layers": (16, 8), "dropout_keep": (1.0, 1.0),
+            "tiered_embeddings": True, "tiered_page_rows": 64,
+        },
+        "optimizer": {"lazy_embedding_updates": True,
+                      "learning_rate": 5e-3},
+        "data": {"batch_size": B},
+    })
+
+
+def _batch(rng, lo: int, hi: int) -> dict:
+    return {
+        "feat_ids": rng.integers(lo, hi, (B, F)).astype(np.int64),
+        "feat_vals": rng.random((B, F), dtype=np.float32),
+        "label": (rng.random(B) < 0.3).astype(np.float32),
+    }
+
+
+def test_cold_outage_training_stalls_serving_stays_up(tmp_path):
+    cfg = _cfg()
+    rng = np.random.default_rng(0)
+    server, base = serve(str(tmp_path / "store"))
+    try:
+        # patient training-side policy: a 10 s outage is a stall, not a
+        # crash (bounded overall by the attempt budget)
+        train_retry = RetryPolicy(max_attempts=200, base_delay_secs=0.25,
+                                  max_delay_secs=1.0)
+        trainer = TieredTrainer.from_resident_state(
+            cfg, create_train_state(cfg), f"{base}/bucket/cold",
+            capacity=B * F, stage_rows=B * F, host_rows=4 * B * F,
+            retry=train_retry)
+        # warm phase: each batch draws from a DISJOINT id window so every
+        # later step is guaranteed to need cold-tier pages
+        windows = [(i * B * F, (i + 1) * B * F) for i in range(16)]
+        for lo, hi in windows[:4]:
+            trainer.train_batch(_batch(rng, lo, hi))
+        pub = ModelPublisher(str(tmp_path / "pub"), keep=1)
+        pub.publish_tiered(cfg, trainer)
+
+        # serving side: OWN cold tier handle, fail-fast retry, warmed on
+        # a fixed probe set (hot/host-resident through the outage)
+        scorer = TieredScorer.from_publish(
+            str(tmp_path / "pub"), str(tmp_path / "staging"),
+            capacity=B * F, host_rows=4 * B * F,
+            retry=RetryPolicy(max_attempts=2, base_delay_secs=0.01,
+                              max_delay_secs=0.05))
+        probe = _batch(rng, 0, B * F)
+        scorer.warm(probe["feat_ids"])
+        baseline = scorer.score(probe["feat_ids"], probe["feat_vals"])
+
+        steps_done = []          # wall-clock of each completed train step
+        train_err = []
+
+        def train_rest():
+            try:
+                for lo, hi in windows[4:]:
+                    trainer.train_batch(_batch(rng, lo, hi))
+                    steps_done.append(time.monotonic())
+                    # steady production cadence (an event-stream trainer
+                    # paces on arrivals); keeps steps in flight when the
+                    # outage lands instead of burning the queue first
+                    time.sleep(0.3)
+            except BaseException as e:  # surfaced in the main assert
+                train_err.append(e)
+
+        t = threading.Thread(target=train_rest, daemon=True)
+        t.start()
+        time.sleep(0.4)
+
+        # ---- kill the cold tier (reads AND writes) for 10 s ----------
+        server.fault_plan.add(verb="GET", key="bucket/cold/*", status=503)
+        server.fault_plan.add(verb="HEAD", key="bucket/cold/*", status=503)
+        outage_start = time.monotonic()
+        failed, ok = 0, 0
+        while time.monotonic() - outage_start < OUTAGE_SECS:
+            try:
+                got = scorer.score(probe["feat_ids"], probe["feat_vals"])
+                np.testing.assert_array_equal(got, baseline)
+                ok += 1
+            except Exception:
+                failed += 1
+            time.sleep(0.02)
+        steps_during = sum(1 for s in steps_done if s >= outage_start)
+        server.fault_plan.clear()
+
+        t.join(timeout=180)
+        assert not t.is_alive(), "training never resumed after the outage"
+        assert not train_err, f"training crashed during the outage: " \
+                              f"{train_err!r}"
+        # serving: stale-but-serving, zero failures on resident rows
+        assert failed == 0 and ok > 50, (failed, ok)
+        # training: stalled during the outage (every remaining step needs
+        # new cold pages; at most the in-flight one completes) ...
+        assert steps_during <= 2, f"{steps_during} steps completed " \
+            f"DURING a dead cold tier — paging was not actually exercised"
+        # ... and resumed: every step eventually completed, with the
+        # stall visible in the cold tier's accounting
+        assert len(steps_done) == len(windows) - 4
+        stats = trainer.cold.stats()
+        assert stats["stall_secs"] > 2.0, stats
+        assert server.fault_plan.to_dict()["fired_total"] > 0
+        trainer.close()
+    finally:
+        server.shutdown()
+        server.server_close()
