@@ -54,6 +54,15 @@ not a benchmark:
   vacuously).  The per-mode expected sets live in
   :data:`EXCHANGE_CONTRACT`.
 
+* **sharded-predict audit** — lower the shard-group serving pool's
+  predict (``serve.pool.sharded.build_sharded_predict_with``) on the
+  audited serve meshes and hold it to the pool's contract: lowers under
+  ``transfer_guard('disallow')``, carries the all_to_all exchange with
+  no dense row tensor outside the fallback arm, every admissible size
+  per group lands on a precompiled data-axis-divisible bucket, and two
+  same-spec payloads lower identically (a group swap is a cache hit —
+  no mixed-generation executable can exist).
+
 Failures are reported as the same :class:`~.findings.Finding` records as
 engine 1 (rules ``trace-transfer`` / ``trace-recompile`` /
 ``trace-donation`` / ``trace-dtype``) so the CLI, baseline, and JSON
@@ -757,6 +766,204 @@ def audit_spmd_exchange(cfg=None) -> list[Finding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# sharded-predict contract (shard-group serving pool, deepfm_tpu/serve/pool)
+
+# the serve-group topologies the pool's bit-parity tests pin — both are
+# audited so neither mesh orientation can regress silently
+_SERVE_AUDIT_MESHES = ((2, 4), (4, 2))
+
+
+def _bucket_divisibility(buckets, data_parallel: int) -> list[Finding]:
+    """The per-dp half of the group recompile contract: every bucket
+    must shard evenly over the group's data axis — an indivisible bucket
+    would need a padded per-shard shape the engine never compiled, i.e.
+    a live-request compile."""
+    where = "deepfm_tpu/serve/pool/worker.py"
+    dp = max(1, int(data_parallel))
+    bad = sorted(int(b) for b in buckets if int(b) % dp != 0)
+    if not bad:
+        return []
+    return [_finding(
+        "trace-recompile",
+        f"bucket shapes {bad} do not divide over the serve group's "
+        f"data_parallel={dp} — the dispatch cannot shard evenly and "
+        f"would lower a shape no group executable was compiled for",
+        hint="pick bucket sizes divisible by the group mesh's data "
+             "axis (GroupMember validates this at construction)",
+        where=where, slug="serve-bucket-indivisible",
+    )]
+
+
+def audit_group_buckets(
+    buckets=None, data_parallel: int = 1
+) -> list[Finding]:
+    """Recompile contract for ONE shard-group's engine: every admissible
+    dispatch size must land on a precompiled bucket (audit_buckets) that
+    shards evenly over the group's data axis (_bucket_divisibility)."""
+    buckets = _default_buckets() if buckets is None else buckets
+    return (list(audit_buckets(buckets))
+            + _bucket_divisibility(buckets, data_parallel))
+
+
+def audit_sharded_predict(cfg=None, predict_builder=None) -> list[Finding]:
+    """The shard-group predict's lowering contract
+    (serve/pool/sharded.py), on every audited serve mesh:
+
+    * **transfer** — every bucket lowers under
+      ``transfer_guard('disallow')``: weights and ids enter only through
+      the declared arguments;
+    * **collective traffic** — in ``alltoall`` mode the lowering carries
+      the all_to_all request/response pair and NO dense row-tensor
+      all-reduce/all-gather outside the ``stablehlo.case`` fallback arms
+      (:data:`EXCHANGE_CONTRACT`); the ``psum``-mode lowering must show
+      the dense all-reduce (detector self-check — a blind scanner fails
+      loudly instead of passing vacuously);
+    * **swap is a cache hit / no mixed-generation executable** — two
+      distinct same-spec payloads lower to identical signatures and
+      modules, and the payload leaves appear as lowered PARAMETERS: a
+      group commit can never recompile mid-traffic, and no version- or
+      generation-dependent value can be baked into an executable (which
+      is what a "mixed-generation executable" would be);
+    * **recompile coverage** — every admissible request size per group
+      maps onto a precompiled bucket that shards evenly over the group's
+      data axis (:func:`audit_group_buckets`).
+
+    ``predict_builder(ctx)`` lets the seeded-violation tests feed a
+    contract-breaking predict (baked payload, psum lowering labeled
+    alltoall) through the same checks."""
+    import sys
+
+    import jax
+
+    if len(jax.devices()) < 8:
+        print(
+            "trace-audit: sharded-predict contract SKIPPED — needs >= 8 "
+            "devices (run under JAX_PLATFORMS=cpu with "
+            "--xla_force_host_platform_device_count=8; scripts/check.sh "
+            "and the analysis CLI arrange this)",
+            file=sys.stderr,
+        )
+        return []
+    from ..serve.pool.sharded import (
+        abstract_serve_payload,
+        build_serve_mesh,
+        build_sharded_predict_with,
+        make_serve_context,
+    )
+
+    base = cfg or _audit_cfg()
+    where = "deepfm_tpu/serve/pool/sharded.py"
+    builder = predict_builder or build_sharded_predict_with
+    out: list[Finding] = []
+    buckets = _default_buckets()
+    for dp, mp in _SERVE_AUDIT_MESHES:
+        mesh = build_serve_mesh(dp, mp)
+        ctx = make_serve_context(base, mesh, exchange="alltoall")
+        payload = abstract_serve_payload(ctx)
+        predict_with = builder(ctx)
+        f = ctx.cfg.model.field_size
+
+        def args(b):
+            return (
+                jax.ShapeDtypeStruct((b, f), jax.numpy.int64),
+                jax.ShapeDtypeStruct((b, f), jax.numpy.float32),
+            )
+
+        def lower_with(pay, a):
+            try:
+                return predict_with.lower(pay, *a)
+            except TypeError:
+                # a predict that dropped the payload argument (weights —
+                # and therefore a generation — baked into the executable)
+                # still lowers; the leaf-count contract below convicts it
+                return predict_with.lower(*a)
+
+        lowered = {}
+        try:
+            with jax.transfer_guard("disallow"):
+                for b in buckets:
+                    lowered[b] = lower_with(payload, args(b))
+        except Exception as e:
+            out.append(_finding(
+                "trace-transfer",
+                f"lowering the sharded predict on mesh [{dp},{mp}] under "
+                f"transfer_guard('disallow') raised "
+                f"{type(e).__name__}: {e}",
+                hint="the sharded predict moved host data implicitly — "
+                     "weights and ids must be arguments",
+                where=where, slug=f"serve-{dp}x{mp}-transfer-guard",
+            ))
+            continue
+        # collective traffic: the per-shard dense row tensor must not
+        # ride an all-reduce/all-gather outside the fallback arm
+        b0 = max(buckets)
+        b_local = b0 // dp
+        k = ctx.cfg.model.embedding_size
+        dense = {(b_local, f, k), (b_local, f)}
+        out.extend(check_exchange_collectives(
+            lowered[b0].as_text(), dense, mode="alltoall",
+            variant=f"serve-{dp}x{mp}", where=where,
+        ))
+        # swap == cache hit, and no generation can bake into the module
+        payload2 = abstract_serve_payload(ctx)
+        b1 = buckets[0]
+        lo2 = lower_with(payload2, args(b1))
+        if lowered[b1].in_avals != lo2.in_avals:
+            out.append(_finding(
+                "trace-recompile",
+                f"sharded predict on mesh [{dp},{mp}]: a same-spec "
+                f"replacement payload changed the input signature — a "
+                f"group commit would MISS the jit cache and recompile "
+                f"mid-traffic",
+                hint="keep the payload a plain argument pytree "
+                     "(serve/pool/sharded.py build_sharded_predict_with)",
+                where=where, slug=f"serve-{dp}x{mp}-swap-signature",
+            ))
+        elif lowered[b1].as_text() != lo2.as_text():
+            out.append(_finding(
+                "trace-recompile",
+                f"sharded predict on mesh [{dp},{mp}]: same-spec payloads "
+                f"lowered to different modules — payload identity (a "
+                f"version/generation) leaked into the executable",
+                hint="no host reads of the payload inside the predict",
+                where=where, slug=f"serve-{dp}x{mp}-swap-module",
+            ))
+        n_payload = len(jax.tree_util.tree_leaves(payload))
+        n_in = len(jax.tree_util.tree_leaves(lowered[b1].in_avals))
+        if n_in != n_payload + 2:
+            out.append(_finding(
+                "trace-recompile",
+                f"sharded predict on mesh [{dp},{mp}] has {n_in} input "
+                f"leaves, expected {n_payload} payload leaves + ids + "
+                f"vals — weights were baked in as constants (every group "
+                f"commit would recompile, and mid-swap the members would "
+                f"serve MIXED-generation executables)",
+                hint="jit the params-as-argument form "
+                     "(serve/pool/sharded.py build_sharded_predict_with)",
+                where=where, slug=f"serve-{dp}x{mp}-params-baked",
+            ))
+        # detector self-check: the psum lowering must show the dense
+        # all-reduce, or the alltoall pass above proves nothing
+        ctx_psum = make_serve_context(base, mesh, exchange="psum")
+        psum_pw = builder(ctx_psum)
+        try:
+            psum_text = psum_pw.lower(
+                abstract_serve_payload(ctx_psum), *args(b0)
+            ).as_text()
+        except TypeError:
+            psum_text = psum_pw.lower(*args(b0)).as_text()
+        out.extend(check_exchange_collectives(
+            psum_text, dense, mode="psum",
+            variant=f"serve-{dp}x{mp}", where=where,
+        ))
+        # per-dp recompile coverage (the mesh-independent admission map
+        # is audited once by run_trace_audit's audit_buckets pass —
+        # re-running it per mesh would duplicate its findings)
+        out.extend(_bucket_divisibility(buckets, dp))
+    return out
+
+
 def run_trace_audit(cfg=None) -> list[Finding]:
     """All engine-2 audits against the real entrypoints (abstract values
     only; no step executes).  Importing jax is the price of admission —
@@ -767,4 +974,5 @@ def run_trace_audit(cfg=None) -> list[Finding]:
     findings.extend(audit_train_step(cfg))
     findings.extend(audit_paged_step(cfg))
     findings.extend(audit_spmd_exchange(cfg))
+    findings.extend(audit_sharded_predict(cfg))
     return findings

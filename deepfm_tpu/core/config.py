@@ -345,6 +345,21 @@ class RunConfig:
     # canary probe, with in-flight dispatches drained across the swap.
     serve_reload_url: str = ""
     serve_reload_interval_secs: float = 2.0
+    # router-fronted shard-group serving pool (serve/pool/): >0 runs the
+    # serve task as `serve_groups` shard-group member processes (tables
+    # row-sharded over each group's mesh, the alltoall exchange on the
+    # predict path) behind the consistent-hashing router
+    serve_groups: int = 0
+    # per-group mesh shape: batch sharding x table row sharding.
+    # model_parallel 0 = auto (the member host's devices / data_parallel)
+    serve_group_data_parallel: int = 1
+    serve_group_model_parallel: int = 0
+    # router front: bind port, max extra shard-groups tried per request,
+    # health-probe cadence, consecutive probe failures before ejection
+    serve_router_port: int = 8500
+    serve_retry_limit: int = 2
+    serve_health_interval_secs: float = 1.0
+    serve_eject_after: int = 2
     # online continuous training (task_type=online-train, online/trainer.py):
     # publish a servable version every N optimizer steps (0 = only at
     # stream end); stop after N batches (0 = unbounded); stop after N

@@ -85,6 +85,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data_parallel", type=int)
     p.add_argument("--model_parallel", type=int)
     p.add_argument(
+        "--serve_groups", type=int,
+        help="task_type=serve: run the router-fronted shard-group pool "
+             "with this many groups (tables row-sharded per group, "
+             "group-atomic hot swap; serve/pool/)",
+    )
+    p.add_argument(
+        "--serve_group_mp", type=int,
+        help="row-shard degree inside each serve group's mesh "
+             "(0 = auto: member host devices / group data_parallel)",
+    )
+    p.add_argument(
         "--set",
         action="append",
         default=[],
@@ -117,6 +128,8 @@ _FLAG_MAP = {
     "model_name": ("model", "model_name"),
     "data_parallel": ("mesh", "data_parallel"),
     "model_parallel": ("mesh", "model_parallel"),
+    "serve_groups": ("run", "serve_groups"),
+    "serve_group_mp": ("run", "serve_group_model_parallel"),
 }
 
 

@@ -156,6 +156,22 @@ def fetch_version(root: str, version: int, staging_dir: str) -> str:
     return dest
 
 
+def resolve_version(
+    root: str, version: int, staging_dir: str
+) -> tuple[Manifest, str]:
+    """``(manifest, local_artifact_dir)`` for one SPECIFIC committed
+    version — the group-atomic swap's staging read (serve/pool/swap.py):
+    every member of a shard-group must stage the SAME version, so the
+    coordinator names it explicitly instead of each member racing
+    ``latest_manifest`` (two members resolving different "latest"s would
+    be exactly the mixed-version state the group swap exists to prevent).
+    Manifest first (a missing manifest means the version is uncommitted —
+    fail before moving bytes), then the artifact via ``fetch_version``."""
+    manifest = read_manifest(root, version)
+    local = fetch_version(root, version, staging_dir)
+    return manifest, local
+
+
 # -- write side -------------------------------------------------------------
 
 class ModelPublisher:

@@ -133,6 +133,40 @@ def exchange_capacity(n_ids: int, num_shards: int, fraction: float) -> int:
     return max(1, min(cap, n_ids))
 
 
+def exchange_wire_bytes_est(
+    n_ids: int,
+    num_shards: int,
+    capacity_fraction: float,
+    widths: tuple[int, ...],
+    *,
+    exchange: str = "alltoall",
+    itemsize: int = 4,
+) -> int:
+    """Estimated per-dispatch collective bytes LEAVING one shard for an
+    ``n_ids``-long local id stream over ``num_shards`` row shards.
+
+    ``alltoall``: the owned-rows-only exchange moves, per table of width
+    K, one ``[M, C]`` int32 request leg plus one ``[M, C, K]`` response
+    leg, of which the ``(M-1)/M`` off-shard fraction is wire traffic —
+    ``(M-1)·C·(K+1)·itemsize`` per table (module docstring).  ``psum``:
+    the dense assembly all-reduces the full ``[N, K]`` row tensor per
+    table — ``2·N·K·itemsize`` as the ring-allreduce bytes-on-wire
+    estimate.  Observability only (the serving router's wire-bytes
+    gauge and the benches); the trace audit, not this number, is the
+    correctness contract."""
+    if num_shards <= 1:
+        return 0
+    total = 0
+    if exchange == "alltoall":
+        cap = exchange_capacity(n_ids, num_shards, capacity_fraction)
+        for k in widths:
+            total += (num_shards - 1) * cap * (int(k) + 1) * itemsize
+    else:
+        for k in widths:
+            total += 2 * n_ids * int(k) * itemsize
+    return total
+
+
 class ExchangePlan(NamedTuple):
     """On-device dedup/routing plan for one id stream (no collectives).
 

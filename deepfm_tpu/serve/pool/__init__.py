@@ -1,0 +1,40 @@
+"""Distributed serving tier: router-fronted shard-group inference pool.
+
+The training side scales with chips (parallel/spmd.py row-shards the
+embedding tables and exchanges owned rows over lax.all_to_all, PR 5); this
+package makes SERVING scale with hosts the same way.  Four modules:
+
+* :mod:`.sharded` — the shard-group executable: embedding tables
+  row-sharded over a serve-group mesh, the deduplicated all_to_all
+  exchange running on the *predict* path inside the MicroBatcher's
+  precompiled bucket executables (psum fallback preserved, jit-stable),
+  weights riding as ARGUMENTS so a group swap is a jit cache hit.
+* :mod:`.worker` — one shard-group member: the sharded scorer behind the
+  serving HTTP surface plus the group-swap admin surface
+  (``:stage``/``:commit``/``:rollback``/``:abort``) and generation-skew
+  protection (a predict pinned to generation G is refused, never scored,
+  by a member on G' != G).
+* :mod:`.router` — the pool front: consistent hashing on the request key
+  -> shard-group with a least-loaded tie-break, bounded
+  retry-on-other-group, ``/healthz``-driven ejection and
+  ``/readyz``-driven re-admission, group-generation pinning, and
+  router-level ``/v1/metrics`` aggregation.
+* :mod:`.swap` — group-atomic hot swap: a new published version commits
+  across ALL members of a shard-group or rolls back, so no request is
+  ever scored by mixed-version shards.
+
+``python -m deepfm_tpu.serve.pool`` (see ``__main__.py``) runs the whole
+tier: member processes supervised with bounded equal-jitter restarts
+(utils/retry.run_with_restarts) under a router front.
+"""
+
+from .router import HashRing, Router, start_router  # noqa: F401
+from .sharded import (  # noqa: F401
+    ServeGroupContext,
+    build_sharded_predict_with,
+    load_sharded_servable,
+    make_serve_context,
+    stage_sharded_payload,
+)
+from .swap import GroupSwapper  # noqa: F401
+from .worker import GroupMember, start_member  # noqa: F401

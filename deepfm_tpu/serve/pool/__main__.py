@@ -1,0 +1,226 @@
+"""Run the router-fronted shard-group pool.
+
+    python -m deepfm_tpu.serve.pool --servable D --router \
+        --groups 2 --group-dp 1 --group-mp 4 --port 8500 \
+        [--reload-url PUBLISH_ROOT]
+
+The supervisor process (this one) never initializes a jax backend: it
+spawns one MEMBER PROCESS per shard-group (each re-executes this module
+with ``--member-entry``, builds its serve mesh, loads the row-sharded
+servable, and serves on ``member-port-base + index``), runs the router
+front and — when ``--reload-url`` is given — one group-atomic
+:class:`~.swap.GroupSwapper` per group.
+
+**Crash handling**: each member process runs under
+``utils/retry.run_with_restarts`` — a dead worker is respawned with
+bounded EQUAL-jitter backoff (the resource under pressure gets an actual
+rest), and the router keeps the respawning member ejected until its
+``/readyz`` passes again (engine precompiled, weights loaded).
+
+One member process per host is the deployment shape: the group's mesh
+spans that host's devices and the exchange rides ICI; the CPU developer
+topology gives every member process its own virtual device set.
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+import threading
+
+
+def _member_argv(args, group: str, index: int, port: int) -> list[str]:
+    argv = [
+        sys.executable, "-m", "deepfm_tpu.serve.pool", "--member-entry",
+        "--servable", args.servable, "--group", group,
+        "--member-port", str(port),
+        "--group-dp", str(args.group_dp), "--group-mp", str(args.group_mp),
+        "--buckets", args.buckets, "--max-wait-ms", str(args.max_wait_ms),
+        "--model-name", args.model_name, "--host", args.host,
+    ]
+    if args.exchange:
+        argv += ["--exchange", args.exchange]
+    if args.reload_url:
+        argv += ["--reload-url", args.reload_url]
+    return argv
+
+
+def _supervise_member(args, group: str, index: int, port: int,
+                      stop: threading.Event) -> None:
+    """One member's crash-restart loop: spawn, wait, raise on abnormal
+    exit, respawn under the bounded equal-jitter schedule."""
+    from ...utils.retry import RetryPolicy, run_with_restarts
+
+    def spawn_and_wait() -> None:
+        if stop.is_set():
+            return
+        proc = subprocess.Popen(_member_argv(args, group, index, port))
+        try:
+            while proc.poll() is None:
+                if stop.wait(0.5):
+                    proc.terminate()
+                    proc.wait(timeout=30)
+                    return
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        if proc.returncode != 0 and not stop.is_set():
+            raise RuntimeError(
+                f"member {group} exited with status {proc.returncode}"
+            )
+
+    try:
+        run_with_restarts(
+            spawn_and_wait,
+            max_restarts=args.max_restarts,
+            policy=RetryPolicy(
+                max_attempts=args.max_restarts + 1,
+                base_delay_secs=args.restart_backoff_secs,
+                max_delay_secs=8 * args.restart_backoff_secs,
+                jitter="equal",
+            ),
+            on_restart=lambda n, e, d: print(
+                f"pool: member {group} died ({e}); respawn {n}/"
+                f"{args.max_restarts} in {d:.1f}s", file=sys.stderr,
+            ),
+        )
+    except Exception as e:
+        print(f"pool: member {group} restart budget exhausted: {e}",
+              file=sys.stderr)
+
+
+def _run_member(args) -> int:
+    from .worker import serve_member
+
+    serve_member(
+        args.servable, group=args.group,
+        data_parallel=args.group_dp, model_parallel=args.group_mp,
+        group_index=0,  # a member process owns its host's whole device set
+        model_name=args.model_name, host=args.host,
+        port=args.member_port,
+        buckets=tuple(int(x) for x in args.buckets.split(",")),
+        max_wait_ms=args.max_wait_ms,
+        exchange=args.exchange or None,
+        source=args.reload_url or None,
+    )
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="deepfm-serve-pool", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("--servable", required=True)
+    ap.add_argument("--router", action="store_true",
+                    help="run the consistent-hashing router front")
+    ap.add_argument("--groups", type=int, default=1,
+                    help="shard-group count (one member process each)")
+    ap.add_argument("--group-dp", type=int, default=1,
+                    help="data-parallel degree inside each group's mesh")
+    ap.add_argument("--group-mp", type=int, default=0,
+                    help="row-shard degree inside each group's mesh "
+                         "(0 = auto: the member host's devices / dp)")
+    ap.add_argument("--port", type=int, default=8500,
+                    help="router bind port")
+    ap.add_argument("--member-port-base", type=int, default=8601)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--model-name", default="deepfm")
+    ap.add_argument("--buckets", default="8,32,128,512")
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    ap.add_argument("--exchange", default="",
+                    help="psum|alltoall (default: config 'auto' resolution)")
+    ap.add_argument("--reload-url", default="",
+                    help="publish root: each group gets a group-atomic "
+                         "swap coordinator polling it")
+    ap.add_argument("--reload-interval", type=float, default=2.0)
+    ap.add_argument("--retry-limit", type=int, default=2)
+    ap.add_argument("--eject-after", type=int, default=2)
+    ap.add_argument("--health-interval", type=float, default=1.0)
+    ap.add_argument("--max-restarts", type=int, default=10)
+    ap.add_argument("--restart-backoff-secs", type=float, default=1.0)
+    # internal: the re-exec member entry
+    ap.add_argument("--member-entry", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--group", default="g0", help=argparse.SUPPRESS)
+    ap.add_argument("--member-port", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.member_entry:
+        return _run_member(args)
+
+    # SIGTERM must tear the whole tree down: without a handler the
+    # supervisor dies on the signal's default action and the member
+    # processes ORPHAN onto init, still serving (observed live) — route
+    # it through the same cleanup path as ^C
+    import signal
+
+    def _terminate(*_):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _terminate)
+
+    stop = threading.Event()
+    group_names = [f"g{i}" for i in range(args.groups)]
+    ports = {g: args.member_port_base + i
+             for i, g in enumerate(group_names)}
+    supervisors = [
+        threading.Thread(
+            target=_supervise_member, args=(args, g, i, ports[g], stop),
+            daemon=True, name=f"supervise-{g}",
+        )
+        for i, g in enumerate(group_names)
+    ]
+    for t in supervisors:
+        t.start()
+    urls = {g: [f"http://{args.host}:{ports[g]}"] for g in group_names}
+    print(f"pool: {args.groups} shard-group(s) at "
+          f"{ {g: u[0] for g, u in urls.items()} }", file=sys.stderr)
+
+    swappers = []
+    if args.reload_url:
+        from .swap import GroupSwapper
+
+        for g in group_names:
+            swappers.append(GroupSwapper(
+                urls[g], args.reload_url, group=g,
+                interval_secs=args.reload_interval,
+            ).start())
+
+    try:
+        if args.router:
+            from .router import Router, make_router_handler
+            from ..server import ScoringHTTPServer
+
+            router = Router(
+                urls, model_name=args.model_name,
+                retry_limit=args.retry_limit,
+                eject_after=args.eject_after,
+                probe_interval_secs=args.health_interval,
+            ).start()
+            httpd = ScoringHTTPServer(
+                (args.host, args.port), make_router_handler(router)
+            )
+            print(
+                f"pool router: serving {args.model_name} on "
+                f"http://{args.host}:{httpd.server_address[1]}"
+                f"/v1/models/{args.model_name}:predict",
+                file=sys.stderr,
+            )
+            httpd.serve_forever()
+        else:
+            threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        stop.set()
+        for s in swappers:
+            s.stop()
+        for t in supervisors:
+            t.join(timeout=40)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,485 @@
+"""The pool front: consistent hashing -> shard-group, health-driven
+ejection, generation pinning, bounded cross-group retry.
+
+Pure control plane — no jax anywhere in this module, so the router can
+run in the supervisor process (serve/pool/__main__.py) or any sidecar.
+
+* **Consistent hashing** (:class:`HashRing`): request key -> ordered
+  candidate groups via a virtual-node ring (``replicas`` vnodes per
+  group).  Removing one of ``n`` groups moves ONLY the keys that mapped
+  to it (≈K/n of K keys); every other key keeps its group — the property
+  the churn test pins.
+* **Least-loaded tie-break**: among the first ``spread`` ring candidates
+  that are healthy, the one with the fewest router-tracked in-flight
+  rows wins (keys stay sticky under even load; a hot group sheds its
+  overflow to its ring successor instead of queueing).
+* **Bounded retry**: a failed forward (connection error, or a 5xx other
+  than 503 — that one is the engine's backpressure signal, not a health
+  verdict) marks the member toward ejection and tries the next candidate
+  group, at most ``retry_limit`` extra groups; exhaustion answers 503.
+* **Ejection / re-admission**: a background prober GETs every member's
+  ``/healthz``; ``eject_after`` consecutive failures ejects the member
+  (``ejections_total``).  An ejected member is probed on ``/readyz`` and
+  re-admitted only when that passes (``readmissions_total``) — a
+  respawning worker stays out of rotation until its engine has
+  precompiled and its weights are loaded.
+* **Generation pinning**: the router caches each group's generation
+  (from readiness probes and responses) and pins every forwarded request
+  to it via ``X-Pinned-Generation``.  A member mid-swap answers 409 (a
+  skew abort, counted) instead of scoring; the router re-reads the
+  generation and retries — so a client can never observe a response
+  scored by mixed-version shards.
+* **Metrics**: ``GET /v1/metrics`` aggregates per-group p50/p95/p99
+  (router-measured, sliding window), requests/retries/skew-aborts/
+  ejections/re-admissions, and each group's exchange wire-bytes estimate
+  (cached from readiness probes).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from ..server import ScoringHTTPServer, _send_json
+from http.server import BaseHTTPRequestHandler
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes.
+
+    ``candidates(key)`` walks clockwise from the key's point and returns
+    every distinct node in ring order — element 0 is the consistent
+    primary; the rest are the deterministic failover order."""
+
+    def __init__(self, nodes=(), *, replicas: int = 64):
+        self._replicas = int(replicas)
+        self._points: list[tuple[int, str]] = []
+        self._nodes: set[str] = set()
+        for n in nodes:
+            self.add(n)
+
+    @staticmethod
+    def _hash(s: str) -> int:
+        return int.from_bytes(hashlib.md5(s.encode()).digest()[:8], "big")
+
+    def add(self, node: str) -> None:
+        if node in self._nodes:
+            return
+        self._nodes.add(node)
+        for i in range(self._replicas):
+            self._points.append((self._hash(f"{node}#{i}"), node))
+        self._points.sort()
+
+    def remove(self, node: str) -> None:
+        if node not in self._nodes:
+            return
+        self._nodes.discard(node)
+        self._points = [(h, n) for h, n in self._points if n != node]
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def candidates(self, key: str, n: int | None = None) -> list[str]:
+        if not self._points:
+            return []
+        want = len(self._nodes) if n is None else min(n, len(self._nodes))
+        h = self._hash(key)
+        # bisect to the key's point, then walk clockwise collecting
+        # distinct nodes
+        import bisect
+
+        idx = bisect.bisect_left(self._points, (h, ""))
+        out: list[str] = []
+        for off in range(len(self._points)):
+            node = self._points[(idx + off) % len(self._points)][1]
+            if node not in out:
+                out.append(node)
+                if len(out) == want:
+                    break
+        return out
+
+
+class _Window:
+    """Per-group sliding latency window (the batcher's reservoir idiom)."""
+
+    def __init__(self, size: int = 2048):
+        self._lat = np.zeros(size, np.float64)
+        self._n = 0
+
+    def record(self, seconds: float) -> None:
+        self._lat[self._n % self._lat.size] = seconds
+        self._n += 1
+
+    def snapshot(self) -> dict:
+        n = min(self._n, self._lat.size)
+        out = {"count": int(self._n)}
+        if n:
+            w = np.sort(self._lat[:n])
+            for name, q in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
+                out[name] = round(1e3 * float(w[int((n - 1) * q)]), 3)
+        return out
+
+
+class _Member:
+    __slots__ = ("url", "healthy", "fails", "inflight", "doc")
+
+    def __init__(self, url: str):
+        self.url = url
+        self.healthy = True       # optimistic: probed immediately
+        self.fails = 0
+        self.inflight = 0
+        self.doc: dict = {}       # last readiness doc (generation, wire est)
+
+
+class Router:
+    """Route predict requests across shard-groups (module docstring).
+
+    ``groups`` maps group name -> list of member base URLs.  Thread-safe;
+    ``start()`` launches the health prober, ``close()`` stops it."""
+
+    def __init__(
+        self,
+        groups: dict[str, list[str]],
+        *,
+        model_name: str = "deepfm",
+        retry_limit: int = 2,
+        spread: int = 2,
+        eject_after: int = 2,
+        probe_interval_secs: float = 1.0,
+        request_timeout_secs: float = 60.0,
+    ):
+        if not groups:
+            raise ValueError("router needs at least one shard-group")
+        self.model_name = model_name
+        self._ring = HashRing(sorted(groups))
+        self._members = {
+            g: [_Member(u) for u in urls] for g, urls in groups.items()
+        }
+        self._retry_limit = int(retry_limit)
+        self._spread = max(1, int(spread))
+        self._eject_after = max(1, int(eject_after))
+        self._probe_interval = float(probe_interval_secs)
+        self._timeout = float(request_timeout_secs)
+        self._lock = threading.Lock()
+        self._generation: dict[str, int] = {}
+        self._windows = {g: _Window() for g in groups}
+        self._group_requests = {g: 0 for g in groups}
+        self.requests_total = 0
+        self.retries_total = 0
+        self.skew_aborts_total = 0
+        self.ejections_total = 0
+        self.readmissions_total = 0
+        self.no_capacity_total = 0
+        self._stop = threading.Event()
+        self._prober: threading.Thread | None = None
+
+    # -- health -------------------------------------------------------------
+    def _get_json(self, url: str, timeout: float = 5.0) -> dict:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.load(r)
+
+    def _probe_member(self, group: str, m: _Member) -> None:
+        try:
+            if m.healthy:
+                self._get_json(m.url + "/healthz")
+                # readiness carries generation + wire estimate (the
+                # group_status merge, serve/server.py)
+                doc = self._get_json(m.url + "/readyz")
+            else:
+                # ejected members must pass READINESS (engine compiled,
+                # weights loaded) to re-enter rotation, not mere liveness
+                doc = self._get_json(m.url + "/readyz")
+            ok = bool(doc.get("ready", True))
+        except Exception as e:
+            # the failure IS the probe result; keep it observable on the
+            # member record (surfaces in /v1/metrics while ejected)
+            ok, doc = False, {"probe_error": f"{type(e).__name__}: {e}"}
+        with self._lock:
+            if ok:
+                if not m.healthy:
+                    self.readmissions_total += 1
+                m.healthy, m.fails, m.doc = True, 0, doc
+                if "group_generation" in doc:
+                    self._generation[group] = int(doc["group_generation"])
+            else:
+                m.fails += 1
+                if m.healthy and m.fails >= self._eject_after:
+                    m.healthy = False
+                    self.ejections_total += 1
+
+    def probe_once(self) -> None:
+        for g, members in self._members.items():
+            for m in members:
+                self._probe_member(g, m)
+
+    def _probe_loop(self) -> None:
+        while not self._stop.is_set():
+            self.probe_once()
+            self._stop.wait(self._probe_interval)
+
+    def start(self) -> "Router":
+        self.probe_once()  # populate generations before traffic
+        if self._prober is None:
+            self._prober = threading.Thread(
+                target=self._probe_loop, daemon=True, name="router-prober"
+            )
+            self._prober.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._prober is not None:
+            self._prober.join(timeout=10)
+            self._prober = None
+
+    # -- routing ------------------------------------------------------------
+    @staticmethod
+    def request_key(body: dict) -> str:
+        """The routing key: an explicit top-level ``"key"`` when the
+        client supplies one (sticky sessions / cache affinity), else a
+        content hash of the instances — deterministic, so identical
+        requests land on the same group."""
+        if "key" in body:
+            return str(body["key"])
+        return hashlib.md5(
+            json.dumps(body.get("instances", []), sort_keys=True).encode()
+        ).hexdigest()
+
+    def _healthy_members(self, group: str) -> list[_Member]:
+        return [m for m in self._members[group] if m.healthy]
+
+    def _plan(self, key: str) -> list[str]:
+        """Candidate groups in try-order: ring order, with the first
+        ``spread`` healthy candidates re-ranked by in-flight load (the
+        least-loaded tie-break), then the remaining ring order as
+        failover."""
+        ring = self._ring.candidates(key)
+        healthy = [g for g in ring if self._healthy_members(g)]
+        if not healthy:
+            return []
+        with self._lock:
+            head = sorted(
+                healthy[: self._spread],
+                key=lambda g: sum(
+                    m.inflight for m in self._healthy_members(g)
+                ),
+            )
+        return head + [g for g in healthy if g not in head]
+
+    def handle_predict(self, body: dict) -> tuple[int, dict]:
+        """Route one predict; returns ``(http_status, response_doc)``.
+        The member's response document passes through untouched (it
+        already carries predictions, model_version, shard_group and
+        group_generation) plus a ``router`` attribution section."""
+        key = self.request_key(body)
+        rows = len(body.get("instances", []))
+        plan = self._plan(key)
+        with self._lock:
+            self.requests_total += 1
+        if not plan:
+            with self._lock:
+                self.no_capacity_total += 1
+            return 503, {"error": "no healthy shard-group"}
+        payload = json.dumps(body).encode()
+        attempts = 0
+        last_err: dict = {"error": "exhausted"}
+        for group in plan[: self._retry_limit + 1]:
+            members = sorted(
+                self._healthy_members(group), key=lambda m: m.inflight
+            )
+            if not members:
+                continue
+            m = members[0]
+            # one in-group re-pin retry: a 409 means OUR generation was
+            # stale (the group swapped under us), not that the group is bad
+            for pin_attempt in range(2):
+                attempts += 1
+                if attempts > 1:
+                    with self._lock:
+                        self.retries_total += 1
+                gen = self._generation.get(group)
+                headers = {"Content-Type": "application/json"}
+                if gen is not None:
+                    headers["X-Pinned-Generation"] = str(gen)
+                req = urllib.request.Request(
+                    f"{m.url}/v1/models/{self.model_name}:predict",
+                    data=payload, headers=headers,
+                )
+                t0 = time.perf_counter()
+                with self._lock:
+                    m.inflight += rows
+                try:
+                    with urllib.request.urlopen(
+                        req, timeout=self._timeout
+                    ) as r:
+                        doc = json.load(r)
+                    with self._lock:
+                        self._windows[group].record(
+                            time.perf_counter() - t0
+                        )
+                        self._group_requests[group] += 1
+                        if "group_generation" in doc:
+                            self._generation[group] = int(
+                                doc["group_generation"]
+                            )
+                    doc["router"] = {"group": group, "attempts": attempts}
+                    return 200, doc
+                except urllib.error.HTTPError as e:
+                    try:
+                        err = json.load(e)
+                    except (ValueError, OSError):
+                        err = {"error": f"http {e.code}"}
+                    if e.code == 409:
+                        # generation skew: learn the member's live
+                        # generation and retry once, same group
+                        with self._lock:
+                            self.skew_aborts_total += 1
+                            if "group_generation" in err:
+                                self._generation[group] = int(
+                                    err["group_generation"]
+                                )
+                        last_err = err
+                        continue
+                    if e.code in (400, 413):
+                        # the client's fault: no retry can fix the body
+                        return e.code, err
+                    last_err = err
+                    if e.code >= 500 and e.code != 503:
+                        # a server-side failure counts toward ejection
+                        # exactly like a connection failure — a member
+                        # whose engine 500s every predict must leave
+                        # rotation at traffic speed.  503 is exempt: it
+                        # is the engine's BACKPRESSURE signal (bounded
+                        # queue shedding), and ejecting an overloaded-
+                        # but-healthy member would amplify the overload
+                        with self._lock:
+                            m.fails += 1
+                            if m.healthy and m.fails >= self._eject_after:
+                                m.healthy = False
+                                self.ejections_total += 1
+                    break  # 5xx/503: next group
+                except Exception as e:
+                    # connection-level failure: count toward ejection so
+                    # a dead member leaves rotation at traffic speed, not
+                    # probe speed
+                    with self._lock:
+                        m.fails += 1
+                        if m.healthy and m.fails >= self._eject_after:
+                            m.healthy = False
+                            self.ejections_total += 1
+                    last_err = {"error": f"{type(e).__name__}: {e}"}
+                    break
+                finally:
+                    with self._lock:
+                        m.inflight -= rows
+        return 503, last_err
+
+    # -- observability ------------------------------------------------------
+    def metrics_snapshot(self) -> dict:
+        with self._lock:
+            groups = {}
+            for g, members in self._members.items():
+                healthy = [m for m in members if m.healthy]
+                doc = next((m.doc for m in members if m.doc), {})
+                groups[g] = {
+                    "members": len(members),
+                    "healthy_members": len(healthy),
+                    "inflight_rows": sum(m.inflight for m in members),
+                    "generation": self._generation.get(g),
+                    "requests_total": self._group_requests[g],
+                    "latency_ms": self._windows[g].snapshot(),
+                    "exchange_wire_bytes_est": doc.get(
+                        "exchange_wire_bytes_est"
+                    ),
+                    "exchange": doc.get("exchange"),
+                    "mesh": doc.get("mesh"),
+                }
+            return {
+                "router": {
+                    "model": self.model_name,
+                    "groups": len(self._members),
+                    "requests_total": self.requests_total,
+                    "retries_total": self.retries_total,
+                    "skew_aborts_total": self.skew_aborts_total,
+                    "ejections_total": self.ejections_total,
+                    "readmissions_total": self.readmissions_total,
+                    "no_capacity_total": self.no_capacity_total,
+                    "retry_limit": self._retry_limit,
+                },
+                "groups": groups,
+            }
+
+
+def make_router_handler(router: Router):
+    predict_path = f"/v1/models/{router.model_name}:predict"
+    status_path = f"/v1/models/{router.model_name}"
+
+    class RouterHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        disable_nagle_algorithm = True
+        _send = _send_json
+
+        def do_GET(self):  # noqa: N802
+            if self.path == "/healthz":
+                self._send(200, {"status": "alive", "role": "router"})
+            elif self.path == "/readyz":
+                snap = router.metrics_snapshot()
+                ready = any(
+                    g["healthy_members"] > 0
+                    for g in snap["groups"].values()
+                )
+                self._send(200 if ready else 503,
+                           {"ready": ready, "role": "router"})
+            elif self.path == status_path:
+                self._send(200, {
+                    "model_version_status": [
+                        {"version": "router", "state": "AVAILABLE"}
+                    ],
+                })
+            elif self.path == "/v1/metrics":
+                self._send(200, router.metrics_snapshot())
+            else:
+                self._send(404, {"error": f"unknown path {self.path!r}"})
+
+        def do_POST(self):  # noqa: N802
+            if self.path != predict_path:
+                return self._send(404,
+                                  {"error": f"unknown path {self.path!r}"})
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length))
+                body["instances"]
+            except Exception as e:
+                return self._send(400,
+                                  {"error": f"{type(e).__name__}: {e}"})
+            code, doc = router.handle_predict(body)
+            self._send(code, doc)
+
+        def log_message(self, fmt, *args):
+            pass
+
+    return RouterHandler
+
+
+def start_router(
+    groups: dict[str, list[str]],
+    *,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    **router_kw,
+) -> tuple[ScoringHTTPServer, str, Router]:
+    """Router front on a daemon thread; returns (server, base_url,
+    router).  Callers own shutdown (``server.shutdown();
+    router.close()``)."""
+    router = Router(groups, **router_kw).start()
+    httpd = ScoringHTTPServer((host, port), make_router_handler(router))
+    threading.Thread(
+        target=httpd.serve_forever, daemon=True, name="pool-router"
+    ).start()
+    return httpd, f"http://{host}:{httpd.server_address[1]}", router

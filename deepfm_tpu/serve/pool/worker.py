@@ -1,0 +1,468 @@
+"""One shard-group member: the sharded scorer behind HTTP + swap admin.
+
+A member owns one serve-group mesh slice (sharded.py), the micro-batching
+engine over the sharded predict (every bucket a precompiled executable,
+weights as arguments), and the member half of the group-atomic swap
+protocol (swap.py drives it):
+
+    POST /admin:stage    {"version": V[, "source": URL]}
+        fetch + verify (param hash, spec compatibility) + CANARY the
+        version against the live executables; hold it staged off-traffic.
+    POST /admin:commit   {"generation": G, "version": V}
+        atomically repoint the payload to the staged version and adopt
+        group generation G (drain-aware: returns with all traffic on the
+        new weights).  The previous payload is retained for one
+        generation so a failed group commit can roll back.
+    POST /admin:rollback
+        swap back to the retained previous payload/generation.
+    POST /admin:abort
+        drop the staged payload (nothing was ever live).
+
+**Generation-skew protection**: the router pins each request to one group
+generation via the ``X-Pinned-Generation`` header; a member serving a
+different generation answers 409 (a *skew abort*) instead of scoring —
+so no request is ever scored by mixed-version shards, even mid-commit or
+via a cross-member retry.
+
+The HTTP surface extends ``serve/server.py``'s handler (same
+``:predict``/``:predict_binary``/``/healthz``/``/readyz``/``/v1/metrics``
+routes): predict responses carry ``shard_group`` + ``group_generation``
+alongside ``model_version``, and ``/v1/metrics`` gains the ``router``
+section (the ``group_status`` schema documented on ``make_handler``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+from typing import Callable
+
+import numpy as np
+
+from ..batcher import MicroBatcher
+from ..server import ScoringHTTPServer, make_handler
+from .sharded import group_wire_bytes_est, load_sharded_servable
+
+
+class SwapProtocolError(RuntimeError):
+    """A stage/commit/rollback call arrived out of protocol order (no
+    staged payload, wrong generation, nothing to roll back) — mapped to
+    HTTP 409 so the coordinator can tell protocol misuse from the 4xx/5xx
+    of a genuinely failed verb."""
+
+
+def _canary_batch(cfg, rows: int):
+    """Zeros plus spread in-vocab ids (the HotSwapper probe construction):
+    any non-finite or out-of-range probability fails the staged version."""
+    f = cfg.model.field_size
+    ids = np.zeros((rows, f), np.int64)
+    if rows > 1:
+        ids[1:] = np.linspace(
+            0, max(0, cfg.model.feature_size - 1), (rows - 1) * f,
+            dtype=np.int64,
+        ).reshape(rows - 1, f)
+    return ids, np.ones((rows, f), np.float32)
+
+
+class GroupMember:
+    """The in-process shard-group member (thread- or process-hosted).
+
+    ``mesh`` spans this member's device slice; the tables live row-sharded
+    on it and every predict runs the resolved exchange inside the bucket
+    executables.  All swap-protocol state (staged payload, retained
+    previous payload, group generation) is guarded by one lock; scoring
+    never takes it (the holder's own drain machinery serializes swaps
+    against in-flight dispatches)."""
+
+    def __init__(
+        self,
+        servable_dir: str,
+        mesh,
+        *,
+        group: str = "g0",
+        member: str = "m0",
+        buckets=(8, 32, 128, 512),
+        max_wait_ms: float = 2.0,
+        max_queue_rows: int | None = None,
+        exchange: str | None = None,
+        source: str | None = None,
+        staging_dir: str | None = None,
+        precompile: bool = True,
+    ):
+        predict, predict_with, holder, ctx = load_sharded_servable(
+            servable_dir, mesh, exchange=exchange
+        )
+        dp = ctx.cfg.mesh.data_parallel
+        bad = [b for b in buckets if int(b) % dp != 0]
+        if bad:
+            raise ValueError(
+                f"bucket sizes {bad} are not divisible by the group's "
+                f"data_parallel={dp} — every dispatch shape must shard "
+                f"evenly over the serve mesh"
+            )
+        self.group = group
+        self.member = member
+        self.ctx = ctx
+        self._holder = holder
+        self._predict_with = predict_with
+        self._source = source
+        # per-MEMBER staging: in-process members of one group must not
+        # share an artifact cache, or one member's fetch would satisfy a
+        # sibling's stage and mask its own store path (the chaos tests
+        # script per-member store faults through exactly this seam)
+        self._staging = staging_dir or os.path.join(
+            tempfile.gettempdir(),
+            f"deepfm_pool_{os.getpid()}_{group}_{member}",
+        )
+        os.makedirs(self._staging, exist_ok=True)
+        self.engine = MicroBatcher(
+            predict, ctx.cfg.model.field_size, buckets=buckets,
+            max_wait_ms=max_wait_ms, max_queue_rows=max_queue_rows,
+            name=f"predict[{group}/{member}]",
+        )
+        self._canary = _canary_batch(ctx.cfg, int(sorted(buckets)[0]))
+        self._lock = threading.Lock()
+        self.generation = 0
+        self._staged = None          # (payload, manifest)
+        self._prev = None            # (payload, version, generation)
+        self.skew_aborts_total = 0
+        self.swaps_total = 0
+        self.rollbacks_total = 0
+        self.stage_failures_total = 0
+        if precompile:
+            self.compile_secs = self.engine.precompile()
+
+    # -- serving surface ----------------------------------------------------
+    @property
+    def version(self) -> int:
+        return self._holder.version
+
+    def reload_status(self) -> dict:
+        with self._lock:
+            return {
+                "model_version": self._holder.version,
+                "swaps_total": self.swaps_total,
+                "rollbacks_total": self.rollbacks_total,
+                "stage_failures_total": self.stage_failures_total,
+                "staged_version": (
+                    None if self._staged is None
+                    else self._staged[1].version
+                ),
+            }
+
+    def group_status(self) -> dict:
+        """The ``group_status`` document (schema: serve/server.py
+        make_handler) — predict responses, ``/readyz``, and the
+        ``router`` metrics section all serve this."""
+        cfg = self.ctx.cfg
+        return {
+            "shard_group": self.group,
+            "member": self.member,
+            "group_generation": self.generation,
+            "exchange": self.ctx.exchange,
+            "mesh": [cfg.mesh.data_parallel, cfg.mesh.model_parallel],
+            "exchange_wire_bytes_est": group_wire_bytes_est(
+                self.ctx, max(self.engine.buckets)
+            ),
+            "skew_aborts_total": self.skew_aborts_total,
+        }
+
+    def readiness(self) -> dict:
+        return {
+            "ready": True, "engine_compiled": True, "weights_loaded": True,
+            "model_version": self._holder.version,
+        }
+
+    # -- swap protocol (member half; swap.py is the coordinator) ------------
+    def stage(self, version: int, source: str | None = None) -> dict:
+        """Fetch, verify, and canary version ``version``; hold it staged.
+        Raises on any verification failure (the artifact never goes
+        live); the coordinator maps that to a group-wide abort."""
+        import jax
+
+        from ...models.base import get_model
+        from ...online.publisher import param_tree_hash, resolve_version
+        from ..export import _load_config, _restore_payload
+        from .sharded import stage_sharded_payload
+
+        root = source or self._source
+        if not root:
+            raise ValueError(
+                "no publish root: member has no configured source and the "
+                "stage request named none"
+            )
+        try:
+            manifest, local = resolve_version(root, int(version),
+                                              self._staging)
+            served_cfg = _load_config(local)
+            if (served_cfg.model.field_size
+                    != self.ctx.cfg.model.field_size):
+                raise ValueError(
+                    f"version {version} has field_size "
+                    f"{served_cfg.model.field_size}, group serves "
+                    f"{self.ctx.cfg.model.field_size} — not hot-swappable"
+                )
+            model = get_model(served_cfg.model)
+            params, model_state = _restore_payload(
+                local,
+                lambda: model.init(jax.random.PRNGKey(0), served_cfg.model),
+            )
+            got = param_tree_hash(params, model_state)
+            if manifest.param_hash and got != manifest.param_hash:
+                raise ValueError(
+                    f"version {version} param hash mismatch (manifest "
+                    f"{manifest.param_hash[:12]}…, staged {got[:12]}…) — "
+                    f"torn or corrupted artifact"
+                )
+            payload = stage_sharded_payload(self.ctx, params, model_state)
+            # canary through the LIVE bucket executables (same jit cache)
+            probs = np.asarray(self._predict_with(payload, *self._canary))
+            if not np.isfinite(probs).all():
+                raise ValueError(
+                    f"canary probe produced non-finite scores "
+                    f"({int((~np.isfinite(probs)).sum())}/{probs.size} bad)"
+                )
+            if ((probs < 0.0) | (probs > 1.0)).any():
+                raise ValueError(
+                    "canary probe produced out-of-range scores"
+                )
+        except Exception:
+            with self._lock:
+                self.stage_failures_total += 1
+            raise
+        with self._lock:
+            self._staged = (payload, manifest)
+            return {"staged_version": manifest.version,
+                    "group_generation": self.generation}
+
+    def commit(self, generation: int, version: int,
+               drain_timeout_secs: float = 30.0) -> dict:
+        """Swap the staged payload live and adopt ``generation``.  The
+        old payload is retained for one generation (rollback window).
+
+        ``generation`` must move FORWARD (> the member's current) but
+        need not be the immediate successor: a respawned member restarts
+        at generation 0 with the base servable, and the coordinator's
+        repair pass (swap.py) catches it up by committing the group's
+        CURRENT generation — a jump.  Replays and regressions (<=) stay
+        protocol errors."""
+        with self._lock:
+            generation = int(generation)
+            if self._staged is None:
+                raise SwapProtocolError(
+                    f"commit without a staged payload (member at "
+                    f"generation {self.generation})"
+                )
+            payload, manifest = self._staged
+            if manifest.version != int(version):
+                raise SwapProtocolError(
+                    f"commit names version {version} but staged is "
+                    f"{manifest.version}"
+                )
+            if generation <= self.generation:
+                raise SwapProtocolError(
+                    f"commit generation {generation} does not advance "
+                    f"the member's {self.generation}"
+                )
+            prev = (self._holder.get(), self._holder.version,
+                    self.generation)
+            # adopt the generation BEFORE the payload swap: the swap
+            # installs the new weights immediately and then blocks on the
+            # drain (up to drain_timeout_secs) — a request pinned to the
+            # OLD generation arriving in that window must already be
+            # refused, not scored on the new weights under an old label
+            self.generation = generation
+            drained = self._holder.swap(
+                payload, version=manifest.version, manifest=manifest,
+                drain_timeout_secs=drain_timeout_secs,
+            )
+            self._prev = prev
+            self._staged = None
+            self.swaps_total += 1
+            return {"group_generation": self.generation,
+                    "model_version": self._holder.version,
+                    "drained": bool(drained)}
+
+    def rollback(self) -> dict:
+        """Return to the retained pre-commit payload and generation (the
+        group coordinator's answer to a partial commit)."""
+        with self._lock:
+            if self._prev is None:
+                raise SwapProtocolError("nothing to roll back")
+            payload, ver, gen = self._prev
+            # same ordering as commit: generation first, then the payload
+            self.generation = gen
+            self._holder.swap(payload, version=ver)
+            self._prev = None
+            self.rollbacks_total += 1
+            return {"group_generation": self.generation,
+                    "model_version": self._holder.version}
+
+    def abort(self) -> dict:
+        with self._lock:
+            had = self._staged is not None
+            self._staged = None
+            return {"aborted": had, "group_generation": self.generation}
+
+    def close(self) -> None:
+        self.engine.close()
+
+
+def make_member_handler(member: GroupMember, model_name: str):
+    """The member HTTP surface: serve/server.py's handler (predict,
+    health, metrics — with the group_status extension) plus the swap
+    admin routes and the generation-skew gate."""
+    base = make_handler(
+        member.engine, model_name,
+        reload_status=member.reload_status,
+        readiness=member.readiness,
+        group_status=member.group_status,
+    )
+    predict_paths = {
+        f"/v1/models/{model_name}:predict",
+        f"/v1/models/{model_name}:predict_binary",
+    }
+    admin: dict[str, Callable[[dict], dict]] = {
+        "/admin:stage": lambda b: member.stage(
+            b["version"], b.get("source")
+        ),
+        "/admin:commit": lambda b: member.commit(
+            b["generation"], b["version"]
+        ),
+        "/admin:rollback": lambda b: member.rollback(),
+        "/admin:abort": lambda b: member.abort(),
+    }
+
+    class MemberHandler(base):
+        def do_POST(self):  # noqa: N802
+            if self.path in admin:
+                return self._do_admin(admin[self.path])
+            if self.path in predict_paths:
+                pinned = self.headers.get("X-Pinned-Generation")
+                if pinned is not None:
+                    try:
+                        want = int(pinned)
+                    except ValueError:
+                        self._drain_body()
+                        return self._send(
+                            400, {"error": f"bad X-Pinned-Generation "
+                                           f"{pinned!r}"}
+                        )
+                    if want != member.generation:
+                        # the skew abort: refuse, never score — the
+                        # router re-pins and retries
+                        member.skew_aborts_total += 1
+                        self._drain_body()
+                        return self._send(409, {
+                            "error": "generation skew",
+                            "pinned_generation": want,
+                            "shard_group": member.group,
+                            "group_generation": member.generation,
+                        })
+            return super().do_POST()
+
+        def _drain_body(self):
+            # an early reject must still consume the request body, or the
+            # unread bytes desynchronize the HTTP/1.1 keep-alive framing
+            # (the next request line would be parsed out of this body)
+            length = int(self.headers.get("Content-Length", "0") or 0)
+            while length > 0:
+                chunk = self.rfile.read(min(length, 1 << 16))
+                if not chunk:
+                    break
+                length -= len(chunk)
+
+        def _do_admin(self, fn):
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+            except Exception as e:
+                return self._send(400,
+                                  {"error": f"{type(e).__name__}: {e}"})
+            try:
+                doc = fn(body)
+            except SwapProtocolError as e:
+                return self._send(409, {"error": str(e)})
+            except (ValueError, KeyError, TypeError) as e:
+                return self._send(400,
+                                  {"error": f"{type(e).__name__}: {e}"})
+            except Exception as e:
+                return self._send(500,
+                                  {"error": f"{type(e).__name__}: {e}"})
+            self._send(200, doc)
+
+    return MemberHandler
+
+
+def start_member(
+    servable_dir: str,
+    mesh,
+    *,
+    group: str = "g0",
+    member: str = "m0",
+    model_name: str = "deepfm",
+    host: str = "127.0.0.1",
+    port: int = 0,
+    **member_kw,
+) -> tuple[ScoringHTTPServer, str, GroupMember]:
+    """In-process member on a daemon thread (the test/bench topology; the
+    process-pool CLI wraps ``serve_member`` instead).  Returns
+    ``(server, base_url, member)``; callers own shutdown
+    (``server.shutdown(); member.close()``)."""
+    gm = GroupMember(servable_dir, mesh, group=group, member=member,
+                     **member_kw)
+    httpd = ScoringHTTPServer(
+        (host, port), make_member_handler(gm, model_name)
+    )
+    threading.Thread(
+        target=httpd.serve_forever, daemon=True,
+        name=f"pool-member-{group}-{member}",
+    ).start()
+    url = f"http://{host}:{httpd.server_address[1]}"
+    return httpd, url, gm
+
+
+def serve_member(
+    servable_dir: str,
+    *,
+    group: str,
+    member: str = "m0",
+    data_parallel: int = 1,
+    model_parallel: int = 0,
+    group_index: int = 0,
+    model_name: str = "deepfm",
+    host: str = "127.0.0.1",
+    port: int = 0,
+    ready: threading.Event | None = None,
+    **member_kw,
+) -> None:
+    """Blocking process entry (serve/pool/__main__.py forks one per
+    member): build the group mesh over this member's device slice, load
+    the sharded servable, announce, serve until killed."""
+    import sys
+
+    import jax
+
+    from .sharded import build_serve_mesh
+
+    if model_parallel <= 0:
+        model_parallel = max(1, len(jax.devices()) // max(1, data_parallel))
+    mesh = build_serve_mesh(data_parallel, model_parallel,
+                            group_index=group_index)
+    gm = GroupMember(servable_dir, mesh, group=group, member=member,
+                     **member_kw)
+    httpd = ScoringHTTPServer((host, port),
+                              make_member_handler(gm, model_name))
+    if ready is not None:
+        ready.port = httpd.server_address[1]  # type: ignore[attr-defined]
+        ready.set()
+    print(
+        f"pool member {group}/{member}: serving {model_name} on "
+        f"http://{host}:{httpd.server_address[1]} "
+        f"(mesh [{data_parallel},{model_parallel}], "
+        f"exchange {gm.ctx.exchange})",
+        file=sys.stderr,
+    )
+    httpd.serve_forever()

@@ -321,7 +321,7 @@ def _send_json(self, code: int, payload: dict) -> None:
 
 
 def make_handler(scorer, model_name: str, reload_status=None,
-                 readiness=None):
+                 readiness=None, group_status=None):
     """REST handler over any engine exposing score/score_instances —
     the micro-batching engine in production; the single-lock Scorer only
     in the benchmark baseline.  ``GET /v1/metrics`` serves the engine's
@@ -329,6 +329,23 @@ def make_handler(scorer, model_name: str, reload_status=None,
     section (hit rate, staged/cold bytes, tier residency) whenever the
     engine pages weights through tiers (``paging_snapshot`` hook — the
     tiered giant-vocab scorer, deepfm_tpu/tiered/serving.py).
+
+    ``group_status`` (a zero-arg callable) turns on the shard-group pool
+    surface (serve/pool/): its document —
+
+        {"shard_group": <str>, "group_generation": <int>,
+         "exchange": "alltoall"|"psum", "mesh": [dp, mp],
+         "exchange_wire_bytes_est": <int>}
+
+    — is served as the ``router`` section of ``/v1/metrics`` and merged
+    into the ``/readyz`` document (the pool router reads generation +
+    wire-bytes from readiness probes); every JSON ``:predict`` response
+    carries its ``shard_group`` and ``group_generation`` keys (so a
+    client sees WHICH group and generation scored it, alongside the
+    existing ``model_version``) without the rest of the gauge noise.  The
+    binary predict path stays a bare float array — group attribution
+    rides the ``X-Shard-Group`` / ``X-Group-Generation`` response headers
+    there.
 
     ``reload_status`` (a zero-arg callable returning the HotSwapper status
     dict, serve/reload.py) turns on hot-reload observability: the status
@@ -365,6 +382,8 @@ def make_handler(scorer, model_name: str, reload_status=None,
                 doc = (readiness() if readiness is not None
                        else {"ready": True, "engine_compiled": True,
                              "weights_loaded": True})
+                if group_status is not None:
+                    doc = {**doc, **group_status()}
                 self._send(200 if doc.get("ready") else 503, doc)
             elif self.path == status_path:
                 version = "1"
@@ -389,6 +408,8 @@ def make_handler(scorer, model_name: str, reload_status=None,
                 if "paging" not in snap and hasattr(
                         scorer, "paging_snapshot"):
                     snap["paging"] = scorer.paging_snapshot()
+                if group_status is not None:
+                    snap["router"] = group_status()
                 self._send(200, snap)
             else:
                 self._send(404, {"error": f"unknown path {self.path!r}"})
@@ -430,6 +451,12 @@ def make_handler(scorer, model_name: str, reload_status=None,
                 # engine — for exact score provenance compare against the
                 # published artifact (its manifest carries param_hash)
                 doc["model_version"] = reload_status().get("model_version", 0)
+            if group_status is not None:
+                gs = group_status()
+                doc.update({
+                    k: gs[k] for k in ("shard_group", "group_generation")
+                    if k in gs
+                })
             self._send(200, doc)
 
         def _predict_binary(self):
@@ -480,6 +507,12 @@ def make_handler(scorer, model_name: str, reload_status=None,
             self.send_header("Content-Type", "application/octet-stream")
             self.send_header("Content-Length", str(len(body)))
             self.send_header("X-Serving-Pid", str(_os.getpid()))
+            if group_status is not None:
+                gs = group_status()
+                self.send_header("X-Shard-Group", str(gs.get("shard_group")))
+                self.send_header(
+                    "X-Group-Generation", str(gs.get("group_generation"))
+                )
             self.end_headers()
             self.wfile.write(body)
 

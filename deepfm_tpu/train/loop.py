@@ -729,6 +729,32 @@ def run_task(cfg: Config):
     if task == "serve":
         from ..serve.server import serve_forever, serve_pool
 
+        if cfg.run.serve_groups > 0:
+            # the router-fronted shard-group pool (serve/pool/): tables
+            # row-sharded over each group's mesh, group-atomic hot swap,
+            # supervised member processes
+            from ..serve.pool.__main__ import main as pool_main
+
+            argv = [
+                "--servable", cfg.run.servable_model_dir, "--router",
+                "--groups", str(cfg.run.serve_groups),
+                "--group-dp", str(cfg.run.serve_group_data_parallel),
+                "--group-mp", str(cfg.run.serve_group_model_parallel),
+                "--port", str(cfg.run.serve_router_port),
+                "--host", cfg.run.serve_host,
+                "--buckets", cfg.run.serve_buckets,
+                "--max-wait-ms", str(cfg.run.serve_max_wait_ms),
+                "--retry-limit", str(cfg.run.serve_retry_limit),
+                "--eject-after", str(cfg.run.serve_eject_after),
+                "--health-interval",
+                str(cfg.run.serve_health_interval_secs),
+            ]
+            if cfg.run.serve_reload_url:
+                argv += ["--reload-url", cfg.run.serve_reload_url,
+                         "--reload-interval",
+                         str(cfg.run.serve_reload_interval_secs)]
+            pool_main(argv)
+            return None
         if cfg.run.serve_workers > 1:
             serve_pool(
                 cfg.run.servable_model_dir,

@@ -98,6 +98,49 @@ class RetryPolicy:
                     self.sleep(delay)
 
 
+def run_with_restarts(
+    fn: Callable[[], T],
+    *,
+    max_restarts: int = 5,
+    policy: RetryPolicy | None = None,
+    should_restart: Callable[[BaseException], bool] | None = None,
+    on_restart: Callable[[int, BaseException, float], None] | None = None,
+) -> T:
+    """Crash-loop supervisor: run ``fn`` to completion, restarting it after
+    each failure with bounded **equal-jitter** backoff.
+
+    This is the process-supervisor discipline (a respawned worker needs the
+    resource under pressure to actually REST, so the backoff keeps a floor
+    — ``jitter="equal"``: uniform(cap/2, cap)) as opposed to the hot-path
+    storage retries RetryPolicy.call defaults to (full jitter, pure
+    decorrelation).  ``fn`` is restarted at most ``max_restarts`` times;
+    the last error propagates.  ``should_restart`` classifies (return
+    False to propagate immediately — e.g. a clean-shutdown sentinel);
+    ``on_restart(attempt, error, delay_secs)`` observes each respawn.
+    The serve-pool member supervisor (serve/pool/__main__.py) runs each
+    worker process under this: a dead worker respawns on this schedule,
+    and the router keeps it ejected until its ``/readyz`` passes again."""
+    policy = policy or RetryPolicy(
+        max_attempts=max_restarts + 1, base_delay_secs=0.5,
+        max_delay_secs=30.0, jitter="equal",
+    )
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except Exception as e:
+            attempt += 1
+            if should_restart is not None and not should_restart(e):
+                raise
+            if attempt > max_restarts:
+                raise
+            delay = policy._draw_delay(attempt)
+            if on_restart is not None:
+                on_restart(attempt, e, delay)
+            if delay > 0:
+                policy.sleep(delay)
+
+
 class CircuitOpenError(RuntimeError):
     """Raised by :meth:`CircuitBreaker.call` when the circuit is open."""
 

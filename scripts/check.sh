@@ -19,8 +19,14 @@ fi
 # JAX_PLATFORMS=cpu; exported here so the gate never silently degrades).
 # The same run enforces the PAGING contract (audit_paged_step): the tiered
 # store's steady-state step must lower with no host transfers outside the
-# designated staging arguments — seeded violations in tests/test_analysis.py
-# prove a smuggled transfer is caught.
+# designated staging arguments — and the SHARDED-PREDICT contract
+# (audit_sharded_predict): the serving pool's shard-group predict must
+# lower with the all_to_all exchange (no dense row tensor outside the
+# fallback arm), cover every admissible per-group dispatch size with a
+# precompiled bucket, and keep group swaps jit cache hits.  Seeded
+# violations in tests/test_analysis.py (smuggled transfer, dense-row leak,
+# off-bucket/indivisible shape, baked mixed-generation payload) prove each
+# contract actually catches its regression.
 exec env JAX_PLATFORMS=cpu \
     XLA_FLAGS="${XLA_FLAGS:+$XLA_FLAGS }--xla_force_host_platform_device_count=8" \
     python -m deepfm_tpu.analysis deepfm_tpu \

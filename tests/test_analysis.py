@@ -1125,3 +1125,115 @@ class TestPagingContract:
             step_builder=lambda c, cap: make_paged_train_step(
                 c, cap, donate=False))
         assert any(f.rule == "trace-donation" for f in findings), findings
+
+
+class TestShardedPredictContract:
+    """The serving pool's sharded-predict trace contract
+    (trace_audit.audit_sharded_predict, wired into scripts/check.sh via
+    run_trace_audit): all_to_all on the predict path, no dense row leak,
+    per-group bucket coverage, swap-is-a-cache-hit."""
+
+    def test_real_sharded_predict_holds_the_contract(self):
+        from deepfm_tpu.analysis.trace_audit import audit_sharded_predict
+
+        findings = audit_sharded_predict()
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_seeded_dense_row_leak_caught(self):
+        """A psum-mode predict lowering fed through the alltoall contract
+        — the shape the regression takes if the pool's exchange wiring
+        breaks — is flagged on both axes: dense traffic on the main
+        line, and no all_to_all present."""
+        import jax
+
+        from deepfm_tpu.analysis.trace_audit import (
+            _audit_cfg,
+            check_exchange_collectives,
+        )
+        from deepfm_tpu.serve.pool.sharded import (
+            abstract_serve_payload,
+            build_serve_mesh,
+            build_sharded_predict_with,
+            make_serve_context,
+        )
+
+        cfg = _audit_cfg()
+        mesh = build_serve_mesh(2, 4)
+        ctx = make_serve_context(cfg, mesh, exchange="psum")
+        pw = build_sharded_predict_with(ctx)
+        f = ctx.cfg.model.field_size
+        b = 32
+        text = pw.lower(
+            abstract_serve_payload(ctx),
+            jax.ShapeDtypeStruct((b, f), jax.numpy.int64),
+            jax.ShapeDtypeStruct((b, f), jax.numpy.float32),
+        ).as_text()
+        dense = {(b // 2, f, ctx.cfg.model.embedding_size), (b // 2, f)}
+        viol = check_exchange_collectives(
+            text, dense, mode="alltoall", variant="serve-seeded",
+            where="deepfm_tpu/serve/pool/sharded.py",
+        )
+        assert any("UNCONDITIONAL main line" in v.message for v in viol)
+        assert any("WITHOUT any all_to_all" in v.message for v in viol)
+        assert all(v.rule == "trace-collective" for v in viol)
+        # the same lowering satisfies the psum self-check
+        assert check_exchange_collectives(
+            text, dense, mode="psum", variant="serve-seeded") == []
+
+    def test_seeded_off_bucket_and_indivisible_shape_caught(self):
+        from deepfm_tpu.analysis.trace_audit import audit_group_buckets
+
+        # a bucket that does not divide over the group's data axis is a
+        # shape no group executable was compiled for
+        findings = audit_group_buckets(buckets=(8, 12), data_parallel=8)
+        assert any(f.rule == "trace-recompile"
+                   and "data_parallel" in f.message for f in findings)
+        # the plain off-bucket regression (engine dispatching raw sizes)
+        # still rides the inherited admission audit
+        import deepfm_tpu.serve.batcher as batcher
+        orig = batcher.pick_bucket
+        batcher.pick_bucket = lambda buckets, rows: rows
+        try:
+            findings = audit_group_buckets(
+                buckets=(8, 32, 128, 512), data_parallel=2)
+            assert any(f.rule == "trace-recompile" for f in findings)
+        finally:
+            batcher.pick_bucket = orig
+        # clean on the real defaults at every audited group dp
+        for dp in (1, 2, 4):
+            assert audit_group_buckets(data_parallel=dp) == []
+
+    def test_seeded_baked_payload_mixed_generation_caught(self):
+        """A predict whose weights compile in as constants is exactly the
+        mixed-generation hazard: each commit would build a NEW executable
+        while old dispatches run the old one.  The leaf-count contract
+        convicts it."""
+        import jax
+
+        from deepfm_tpu.analysis.trace_audit import audit_sharded_predict
+        from deepfm_tpu.models.base import get_model
+        from deepfm_tpu.serve.pool.sharded import (
+            build_sharded_predict_with,
+        )
+
+        def baked_builder(ctx):
+            real = build_sharded_predict_with(ctx)
+            model = get_model(ctx.cfg.model)
+            params, mstate = model.init(
+                jax.random.PRNGKey(0), ctx.cfg.model
+            )
+            concrete = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(x, s),
+                {"params": params, "model_state": mstate},
+                ctx.payload_shardings,
+            )
+
+            @jax.jit
+            def predict_baked(feat_ids, feat_vals):
+                return real(concrete, feat_ids, feat_vals)
+
+            return predict_baked
+
+        findings = audit_sharded_predict(predict_builder=baked_builder)
+        assert any(f.rule == "trace-recompile"
+                   and "baked" in f.message for f in findings), findings

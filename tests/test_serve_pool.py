@@ -1,0 +1,894 @@
+"""Distributed serving tier (deepfm_tpu/serve/pool): consistent-hash
+routing, health-driven ejection/re-admission, group-atomic hot swap with
+version-skew protection, and sharded-predict parity with the
+single-process scorer on both serve-mesh orientations."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from deepfm_tpu.core.config import Config
+from deepfm_tpu.serve import export_servable, load_servable
+from deepfm_tpu.serve.pool.router import HashRing, Router, start_router
+from deepfm_tpu.train import create_train_state
+from deepfm_tpu.utils.dev_object_store import FaultPlan
+
+FEATURE, FIELD = 64, 5
+
+
+# --------------------------------------------------------------------------
+# fixtures: a small servable + a published v1/v2 pair on the dev store
+
+
+def _small_cfg():
+    return Config.from_dict({
+        "model": {
+            "feature_size": FEATURE, "field_size": FIELD,
+            "embedding_size": 4, "deep_layers": (8,),
+            "dropout_keep": (1.0,), "compute_dtype": "float32",
+        },
+        "optimizer": {"learning_rate": 0.01},
+    })
+
+
+@pytest.fixture(scope="module")
+def pool_env(tmp_path_factory):
+    """servable dir + object-store publish root with versions 1 (the
+    servable's weights) and 2 (perturbed weights), plus the store's
+    fault plan for chaos scripting."""
+    import jax
+
+    from deepfm_tpu.online.publisher import ModelPublisher
+    from deepfm_tpu.train.step import TrainState
+    from deepfm_tpu.utils.dev_object_store import serve
+
+    cfg = _small_cfg()
+    state = create_train_state(cfg)
+    root = tmp_path_factory.mktemp("pool")
+    servable = root / "servable"
+    export_servable(cfg, state, servable)
+
+    store_root = root / "store"
+    (store_root / "bucket").mkdir(parents=True)
+    server, base = serve(str(store_root))
+    publish_root = f"{base}/bucket/publish"
+    pub = ModelPublisher(publish_root)
+    m1 = pub.publish(cfg, state)
+    assert m1.version == 1
+    v2_params = jax.tree_util.tree_map(
+        lambda x: x + 0.01 if x.dtype == np.float32 else x, state.params
+    )
+    state2 = TrainState(
+        step=state.step + 100, params=v2_params,
+        model_state=state.model_state, opt_state=state.opt_state,
+        rng=state.rng,
+    )
+    m2 = pub.publish(cfg, state2)
+    assert m2.version == 2
+    yield {
+        "cfg": cfg, "servable": str(servable),
+        "publish_root": publish_root, "plan": server.fault_plan,
+        "state2": state2,
+    }
+    server.shutdown()
+    server.server_close()
+
+
+def _instances(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {"feat_ids": rng.integers(0, FEATURE, FIELD).tolist(),
+         "feat_vals": rng.random(FIELD).round(4).tolist()}
+        for _ in range(n)
+    ]
+
+
+def _post(url, payload, headers=None, timeout=60):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.load(r)
+
+
+# --------------------------------------------------------------------------
+# consistent hashing
+
+
+def test_hash_ring_stability_under_churn():
+    """Removing one of n groups moves ONLY the keys that mapped to it:
+    every key whose primary survives keeps its primary (the <= K/n
+    movement guarantee), and the failover order for surviving keys is
+    unchanged too."""
+    groups = [f"g{i}" for i in range(4)]
+    ring = HashRing(groups)
+    keys = [f"user-{i}" for i in range(8000)]
+    before = {k: ring.candidates(k) for k in keys}
+    ring.remove("g2")
+    moved = 0
+    for k in keys:
+        after = ring.candidates(k)
+        if before[k][0] == "g2":
+            moved += 1
+            # evicted keys land on their PRE-COMPUTED failover group
+            assert after[0] == before[k][1]
+        else:
+            assert after[0] == before[k][0], "a surviving key moved"
+            assert after == [g for g in before[k] if g != "g2"]
+    # vnode balance: the evicted share is ~K/n, not a hot-spotted blob
+    assert 0.5 * len(keys) / 4 < moved < 1.5 * len(keys) / 4
+    # re-adding restores the exact original assignment (hash is pure)
+    ring.add("g2")
+    assert all(ring.candidates(k) == before[k] for k in keys)
+
+
+# --------------------------------------------------------------------------
+# stub members: router logic without jax weight (rides the PR 3 FaultPlan)
+
+
+class _StubMember:
+    """A scriptable member: fixed predictions, FaultPlan-driven health,
+    real generation-skew semantics.  ``port=0`` picks a free port; an
+    explicit port (the respawn-on-same-address model) retries briefly
+    while the OS releases the previous socket."""
+
+    def __init__(self, group, *, plan=None, generation=0, version=0,
+                 port=0):
+        self.group = group
+        self.generation = generation
+        self.version = version
+        self.plan = plan if plan is not None else FaultPlan()
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _send(self, code, doc):
+                body = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                rule = stub.plan.match("GET", self.path.lstrip("/"))
+                if rule is not None and rule.status:
+                    return self._send(rule.status, {"error": "flap"})
+                if self.path == "/healthz":
+                    return self._send(200, {"status": "alive"})
+                if self.path == "/readyz":
+                    return self._send(200, {
+                        "ready": True,
+                        "shard_group": stub.group,
+                        "group_generation": stub.generation,
+                        "exchange_wire_bytes_est": 123,
+                    })
+                return self._send(404, {"error": "nope"})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                pinned = self.headers.get("X-Pinned-Generation")
+                if pinned is not None and int(pinned) != stub.generation:
+                    return self._send(409, {
+                        "error": "generation skew",
+                        "shard_group": stub.group,
+                        "group_generation": stub.generation,
+                    })
+                n = len(body.get("instances", []))
+                return self._send(200, {
+                    "predictions": [0.5] * n,
+                    "model_version": stub.version,
+                    "shard_group": stub.group,
+                    "group_generation": stub.generation,
+                })
+
+            def log_message(self, *a):
+                pass
+
+        deadline = time.time() + 15
+        while True:
+            try:
+                self.httpd = ThreadingHTTPServer(("127.0.0.1", port),
+                                                 Handler)
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.2)
+        self.httpd.daemon_threads = True
+        threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        ).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_router_ejection_and_readmission_on_healthz_flaps():
+    """Scripted /healthz flaps (a FaultPlan rule, the PR 3 chaos layer):
+    eject_after consecutive probe failures ejects the member, traffic
+    fails over to the ring's next group, and recovery re-admits it —
+    counted on /v1/metrics."""
+    a, b = _StubMember("g0"), _StubMember("g1")
+    router = Router(
+        {"g0": [a.url], "g1": [b.url]},
+        retry_limit=1, eject_after=2, probe_interval_secs=30,
+    )
+    try:
+        router.probe_once()
+        snap = router.metrics_snapshot()
+        assert snap["groups"]["g0"]["healthy_members"] == 1
+        # every request keyed to g0 while healthy goes to g0
+        key = next(
+            k for k in (f"k{i}" for i in range(100))
+            if router._ring.candidates(k)[0] == "g0"
+        )
+        code, doc = router.handle_predict(
+            {"key": key, "instances": _instances(2)}
+        )
+        assert code == 200 and doc["router"]["group"] == "g0"
+
+        # flap: the next probes' /healthz answer 503
+        a.plan.add(verb="GET", key="healthz", times=4, status=503)
+        router.probe_once()     # fail 1: still in rotation
+        assert router.metrics_snapshot()["groups"]["g0"][
+            "healthy_members"] == 1
+        router.probe_once()     # fail 2: ejected
+        snap = router.metrics_snapshot()
+        assert snap["groups"]["g0"]["healthy_members"] == 0
+        assert snap["router"]["ejections_total"] == 1
+
+        # ejected: the same key fails over to g1
+        code, doc = router.handle_predict(
+            {"key": key, "instances": _instances(2)}
+        )
+        assert code == 200 and doc["router"]["group"] == "g1"
+
+        # an ejected member is probed on READINESS; once the flap rule
+        # exhausts, it re-enters rotation
+        router.probe_once()
+        snap = router.metrics_snapshot()
+        assert snap["groups"]["g0"]["healthy_members"] == 1
+        assert snap["router"]["readmissions_total"] == 1
+        code, doc = router.handle_predict(
+            {"key": key, "instances": _instances(2)}
+        )
+        assert doc["router"]["group"] == "g0"
+    finally:
+        router.close()
+        a.close()
+        b.close()
+
+
+def test_router_skew_abort_repins_and_retries():
+    """A member mid-swap answers 409 to a stale pinned generation; the
+    router learns the live generation and the retry scores — the client
+    sees one clean 200, never a mixed-version score."""
+    a = _StubMember("g0", generation=3)
+    router = Router({"g0": [a.url]}, retry_limit=0, eject_after=5,
+                    probe_interval_secs=30)
+    try:
+        router.probe_once()
+        assert router._generation["g0"] == 3
+        a.generation = 4  # the group commits under the router
+        code, doc = router.handle_predict({"instances": _instances(1)})
+        assert code == 200
+        assert doc["group_generation"] == 4
+        snap = router.metrics_snapshot()["router"]
+        assert snap["skew_aborts_total"] == 1
+        assert router._generation["g0"] == 4  # re-pinned from the abort
+    finally:
+        router.close()
+        a.close()
+
+
+def test_member_crash_respawn_ejected_until_ready():
+    """The worker crash-handling contract: a dead member is respawned
+    under utils/retry.run_with_restarts (bounded EQUAL-jitter backoff),
+    and the router keeps it ejected until /readyz passes again."""
+    from deepfm_tpu.utils.retry import RetryPolicy, run_with_restarts
+
+    a, b = _StubMember("g0"), _StubMember("g1")
+    port = a.httpd.server_address[1]
+    router = Router({"g0": [a.url], "g1": [b.url]},
+                    retry_limit=1, eject_after=1, probe_interval_secs=30)
+    try:
+        router.probe_once()
+        a.close()  # the crash
+        router.probe_once()
+        assert router.metrics_snapshot()["groups"]["g0"][
+            "healthy_members"] == 0
+        key = next(
+            k for k in (f"k{i}" for i in range(100))
+            if router._ring.candidates(k)[0] == "g0"
+        )
+        code, doc = router.handle_predict(
+            {"key": key, "instances": _instances(1)}
+        )
+        assert code == 200 and doc["router"]["group"] == "g1"
+
+        # the supervisor: two failed spawns, then the member is back on
+        # its ORIGINAL port.  Fake clock — delays recorded, not slept.
+        sleeps = []
+        attempts = {"n": 0}
+        revived = {}
+
+        def spawn():
+            attempts["n"] += 1
+            if attempts["n"] <= 2:
+                raise RuntimeError(
+                    f"member exited (spawn {attempts['n']})"
+                )
+            revived["m"] = _StubMember("g0", port=port)
+
+        policy = RetryPolicy(
+            max_attempts=10, base_delay_secs=1.0, max_delay_secs=8.0,
+            jitter="equal", sleep=sleeps.append,
+        )
+        run_with_restarts(spawn, max_restarts=3, policy=policy)
+        assert attempts["n"] == 3
+        # equal jitter: every delay keeps a floor of half its cap (the
+        # supervisor schedule actually RESTS the resource)
+        assert len(sleeps) == 2
+        for i, d in enumerate(sleeps, start=1):
+            cap = policy.backoff_cap(i)
+            assert cap / 2 <= d <= cap
+
+        # respawned and ready on the registered address: the next probe
+        # re-admits, and the key's traffic returns home
+        try:
+            router.probe_once()
+            snap = router.metrics_snapshot()
+            assert snap["groups"]["g0"]["healthy_members"] == 1
+            assert snap["router"]["readmissions_total"] >= 1
+            code, doc = router.handle_predict(
+                {"key": key, "instances": _instances(1)}
+            )
+            assert doc["router"]["group"] == "g0"
+        finally:
+            revived["m"].close()
+    finally:
+        router.close()
+        b.close()
+
+
+# --------------------------------------------------------------------------
+# real shard-group members: parity, swap atomicity, skew protection
+
+
+@pytest.fixture(scope="module")
+def single_scorer(pool_env):
+    """The production single-process scorer: the weight-parameterized
+    hot-reload predict (serve/reload.py) — the executable family the
+    pool's shard-group predict distributes."""
+    from deepfm_tpu.serve.reload import load_swappable_servable
+
+    predict, _, _, _ = load_swappable_servable(pool_env["servable"])
+    return predict
+
+
+@pytest.mark.parametrize("dp,mp", [(2, 4), (4, 2)])
+@pytest.mark.parametrize("exchange", ["alltoall", "psum"])
+def test_sharded_predict_bit_parity(pool_env, single_scorer, dp, mp,
+                                    exchange):
+    """The sharded predict is BIT-parity with the single-process scorer
+    on both serve-mesh orientations, in the exchange mode and its psum
+    fallback strategy alike.
+
+    The baseline is the weight-parameterized single-process predict
+    (serve/reload.py — what production serving actually runs, since hot
+    reload requires weights-as-arguments).  The closure-constant export
+    scorer (load_servable) compiles weights in as constants, which XLA
+    folds into fusions differently — a pre-existing <=1-ulp divergence
+    between the two single-process paths, pinned here so a real
+    regression can't hide behind 'floats are fuzzy'."""
+    from deepfm_tpu.serve.pool.sharded import (
+        build_serve_mesh,
+        load_sharded_servable,
+    )
+
+    rng = np.random.default_rng(7)
+    ids = rng.integers(0, FEATURE, (16, FIELD))
+    vals = rng.random((16, FIELD), dtype=np.float32)
+    want = np.asarray(single_scorer(ids, vals))
+
+    mesh = build_serve_mesh(dp, mp)
+    predict, _, _, ctx = load_sharded_servable(
+        pool_env["servable"], mesh, exchange=exchange
+    )
+    assert ctx.exchange == exchange
+    got = np.asarray(predict(ids, vals))
+    np.testing.assert_array_equal(got, want)
+
+    # the constants-folded export scorer stays within float32 ulps of
+    # the argument-form executables (the pre-existing gap, not ours)
+    predict_const, _ = load_servable(pool_env["servable"])
+    np.testing.assert_allclose(
+        got, np.asarray(predict_const(ids, vals)), rtol=2e-7, atol=1e-7
+    )
+
+
+def test_version_skew_swap_abort_and_rollback(pool_env):
+    """Group-atomic swap over TWO real members.  A scripted store fault
+    (the PR 3 FaultPlan) fails the SECOND member's stage: the group
+    aborts — both members stay on the old generation and version, and
+    scoring never flinches.  With the fault cleared the same swap
+    commits both members in lockstep.  A commit-phase failure (a member
+    that stages but cannot commit) rolls the committed member BACK."""
+    from deepfm_tpu.serve.pool.sharded import build_serve_mesh
+    from deepfm_tpu.serve.pool.swap import GroupSwapper
+    from deepfm_tpu.serve.pool.worker import start_member
+
+    plan = pool_env["plan"]
+    plan.clear()
+    h1, u1, m1 = start_member(
+        pool_env["servable"], build_serve_mesh(1, 2, group_index=0),
+        group="g0", member="m0", buckets=(4, 8), max_wait_ms=1.0,
+        exchange="alltoall", source=pool_env["publish_root"],
+    )
+    h2, u2, m2 = start_member(
+        pool_env["servable"], build_serve_mesh(1, 2, group_index=1),
+        group="g0", member="m1", buckets=(4, 8), max_wait_ms=1.0,
+        exchange="alltoall", source=pool_env["publish_root"],
+    )
+    try:
+        # warm member 1's artifact cache for version 2 so the fault rule
+        # below only bites member 2's fetch (stage + abort leaves the
+        # fetched artifact cached, nothing live)
+        _post(f"{u1}/admin:stage", {"version": 2})
+        _post(f"{u1}/admin:abort", {})
+
+        sw = GroupSwapper([u1, u2], pool_env["publish_root"], group="g0")
+        plan.set_rules([{
+            "verb": "GET", "key": "bucket/publish/versions/00000002/*",
+            "times": -1, "status": 503,
+        }])
+        try:
+            assert sw.swap_to(2) is False
+        finally:
+            plan.clear()
+        st = sw.status()
+        assert st["rollbacks_total"] == 1
+        assert "stage" in st["last_error"]
+        # the whole group is still on generation 0 / version 0, staged
+        # payloads dropped, and both members still score
+        for u, m in ((u1, m1), (u2, m2)):
+            assert m.generation == 0 and m.version == 0
+            assert m.reload_status()["staged_version"] is None
+            doc = _post(f"{u}/v1/models/deepfm:predict",
+                        {"instances": _instances(3)})
+            assert doc["group_generation"] == 0
+            assert doc["model_version"] == 0
+
+        # fault cleared: the SAME swap commits the whole group
+        assert sw.swap_to(2) is True
+        assert m1.generation == m2.generation == 1
+        assert m1.version == m2.version == 2
+        # post-swap scores match the v2 weights bit-for-bit
+        from deepfm_tpu.serve.reload import build_predict_with
+        from deepfm_tpu.models.base import get_model
+
+        cfg = pool_env["cfg"]
+        pw = build_predict_with(get_model(cfg.model), cfg)
+        inst = _instances(4, seed=11)
+        ids = np.asarray([i["feat_ids"] for i in inst], np.int64)
+        vals = np.asarray([i["feat_vals"] for i in inst], np.float32)
+        want = np.asarray(pw(
+            {"params": pool_env["state2"].params,
+             "model_state": pool_env["state2"].model_state},
+            ids, vals,
+        ))
+        doc = _post(f"{u1}/v1/models/deepfm:predict", {"instances": inst})
+        np.testing.assert_array_equal(
+            np.asarray(doc["predictions"], np.float32), want
+        )
+
+        # a stale pinned generation is REFUSED, never scored
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(f"{u1}/v1/models/deepfm:predict",
+                  {"instances": _instances(1)},
+                  headers={"X-Pinned-Generation": "0"})
+        assert ei.value.code == 409
+        assert json.load(ei.value)["group_generation"] == 1
+
+        # commit-phase failure: a member that stages but cannot commit
+        # forces the committed member to ROLL BACK (generation returns)
+        failing = _failing_commit_stub()
+        sw2 = GroupSwapper([u1, failing.url],
+                           pool_env["publish_root"], group="g0")
+        sw2.generation = 1  # adopt the group's live generation
+        sw2.version = 2
+        try:
+            # version 3: publish fresh weights so there is a swap to try
+            from deepfm_tpu.online.publisher import ModelPublisher
+
+            pub = ModelPublisher(pool_env["publish_root"])
+            pub.publish(cfg, pool_env["state2"])
+            assert sw2.swap_to(3) is False
+            assert "commit" in sw2.status()["last_error"]
+            # the real member went 1 -> 2 -> rolled back to 1
+            assert m1.generation == 1 and m1.version == 2
+            assert m1.rollbacks_total == 1
+            doc = _post(f"{u1}/v1/models/deepfm:predict",
+                        {"instances": _instances(2)})
+            assert doc["group_generation"] == 1
+            assert doc["model_version"] == 2
+        finally:
+            failing.close()
+    finally:
+        h1.shutdown()
+        h2.shutdown()
+        m1.close()
+        m2.close()
+
+
+def _failing_commit_stub():
+    """An admin surface that stages happily and fails every commit —
+    the stand-in for a member that dies between the phases."""
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _send(self, code, doc):
+            body = json.dumps(doc).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", "0"))
+            self.rfile.read(length)
+            if self.path == "/admin:stage":
+                return self._send(200, {"staged_version": 3})
+            if self.path == "/admin:commit":
+                return self._send(500, {"error": "member died mid-commit"})
+            return self._send(200, {"ok": True})
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    httpd.daemon_threads = True
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    class _S:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+        @staticmethod
+        def close():
+            httpd.shutdown()
+            httpd.server_close()
+
+    return _S
+
+
+def test_mid_traffic_group_swap_zero_failed_zero_mixed(pool_env):
+    """The acceptance drill: concurrent clients hammer the router while
+    one group swaps versions group-atomically.  Zero failed predicts,
+    and every response's (generation, version) pair is a COMMITTED
+    state — never a mixed one."""
+    from deepfm_tpu.serve.pool.sharded import build_serve_mesh
+    from deepfm_tpu.serve.pool.swap import GroupSwapper
+    from deepfm_tpu.serve.pool.worker import start_member
+
+    pool_env["plan"].clear()
+    h1, u1, m1 = start_member(
+        pool_env["servable"], build_serve_mesh(1, 2, group_index=2),
+        group="g0", buckets=(4, 8), max_wait_ms=1.0,
+        exchange="alltoall", source=pool_env["publish_root"],
+    )
+    h2, u2, m2 = start_member(
+        pool_env["servable"], build_serve_mesh(1, 2, group_index=3),
+        group="g1", buckets=(4, 8), max_wait_ms=1.0,
+        exchange="alltoall", source=pool_env["publish_root"],
+    )
+    rh, rurl, router = start_router(
+        {"g0": [u1], "g1": [u2]}, retry_limit=1,
+        probe_interval_secs=0.2,
+    )
+    results, errors = [], []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def client(seed):
+        rng = np.random.default_rng(seed)
+        while not stop.is_set():
+            inst = [{
+                "feat_ids": rng.integers(0, FEATURE, FIELD).tolist(),
+                "feat_vals": rng.random(FIELD).round(4).tolist(),
+            }]
+            try:
+                doc = _post(f"{rurl}/v1/models/deepfm:predict",
+                            {"key": f"k{rng.integers(0, 64)}",
+                             "instances": inst})
+                with lock:
+                    results.append((doc["shard_group"],
+                                    doc["group_generation"],
+                                    doc["model_version"]))
+            except Exception as e:  # pragma: no cover - the assertion
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=client, args=(100 + i,))
+               for i in range(8)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(1.0)  # traffic on generation 0
+        sw = GroupSwapper([u1], pool_env["publish_root"], group="g0")
+        assert sw.poll_once() is True  # swaps g0 to the latest version
+        time.sleep(1.0)  # traffic on generation 1
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        router.close()
+        rh.shutdown()
+        h1.shutdown()
+        h2.shutdown()
+        m1.close()
+        m2.close()
+    assert not errors, f"failed predicts during the swap: {errors[:3]}"
+    assert len(results) > 50
+    committed_g0 = {(0, 0), (1, sw.version)}
+    seen_g0 = {(g, v) for grp, g, v in results if grp == "g0"}
+    assert seen_g0 <= committed_g0, f"mixed-version scores: {seen_g0}"
+    assert (1, sw.version) in seen_g0, "swap never became visible"
+    assert all((g, v) == (0, 0)
+               for grp, g, v in results if grp == "g1")
+
+
+def test_member_metrics_router_section_schema(pool_env):
+    """The documented /v1/metrics ``router`` section and /readyz merge
+    (serve/server.py make_handler group_status schema)."""
+    from deepfm_tpu.serve.pool.sharded import build_serve_mesh
+    from deepfm_tpu.serve.pool.worker import start_member
+
+    h, u, m = start_member(
+        pool_env["servable"], build_serve_mesh(2, 4),
+        group="gX", buckets=(4, 8), max_wait_ms=1.0,
+        exchange="alltoall",
+    )
+    try:
+        doc = _post(f"{u}/v1/models/deepfm:predict",
+                    {"instances": _instances(2)})
+        # responses: attribution fields only, alongside model_version
+        assert doc["shard_group"] == "gX"
+        assert doc["group_generation"] == 0
+        assert "model_version" in doc
+        assert "exchange_wire_bytes_est" not in doc
+        with urllib.request.urlopen(f"{u}/v1/metrics", timeout=30) as r:
+            snap = json.load(r)
+        router_sec = snap["router"]
+        assert router_sec["shard_group"] == "gX"
+        assert router_sec["mesh"] == [2, 4]
+        assert router_sec["exchange"] == "alltoall"
+        assert router_sec["exchange_wire_bytes_est"] > 0
+        assert router_sec["skew_aborts_total"] == 0
+        with urllib.request.urlopen(f"{u}/readyz", timeout=30) as r:
+            ready = json.load(r)
+        assert ready["ready"] is True
+        assert ready["group_generation"] == 0
+        assert ready["exchange_wire_bytes_est"] > 0
+    finally:
+        h.shutdown()
+        m.close()
+
+
+def test_group_member_rejects_indivisible_buckets(pool_env):
+    from deepfm_tpu.serve.pool.sharded import build_serve_mesh
+    from deepfm_tpu.serve.pool.worker import GroupMember
+
+    with pytest.raises(ValueError, match="not divisible"):
+        GroupMember(
+            pool_env["servable"], build_serve_mesh(4, 2),
+            buckets=(4, 6), precompile=False,
+        )
+
+
+def test_pool_cli_respawns_killed_member(pool_env):
+    """End-to-end process pool (python -m deepfm_tpu.serve.pool): router
+    + one supervised member process.  SIGKILL the member: the supervisor
+    respawns it (run_with_restarts), the router ejects it while down and
+    re-admits once /readyz passes — predicts succeed again."""
+    import os
+    import signal
+    import socket
+    import subprocess
+    import sys as _sys
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    router_port, member_port = free_port(), free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [_sys.executable, "-m", "deepfm_tpu.serve.pool",
+         "--servable", pool_env["servable"], "--router",
+         "--groups", "1", "--group-dp", "1", "--group-mp", "2",
+         "--port", str(router_port),
+         "--member-port-base", str(member_port),
+         "--buckets", "4,8", "--health-interval", "0.2",
+         "--restart-backoff-secs", "0.2", "--max-restarts", "3"],
+        stderr=subprocess.DEVNULL, env=env,
+    )
+
+    def predict_ok(timeout):
+        deadline = time.time() + timeout
+        body = {"instances": _instances(2, seed=3)}
+        while time.time() < deadline:
+            try:
+                doc = _post(
+                    f"http://127.0.0.1:{router_port}"
+                    f"/v1/models/deepfm:predict", body, timeout=10,
+                )
+                return doc
+            except Exception:
+                time.sleep(0.5)
+        return None
+
+    try:
+        doc = predict_ok(180)
+        assert doc is not None, "pool never served a predict"
+        assert doc["shard_group"] == "g0"
+
+        # find and SIGKILL the member process (the supervised child)
+        out = subprocess.run(
+            ["pgrep", "-f", "deepfm_tpu.serve.pool --member-entry"],
+            capture_output=True, text=True,
+        )
+        pids = [int(p) for p in out.stdout.split()]
+        assert pids, "member process not found"
+        for p in pids:
+            os.kill(p, signal.SIGKILL)
+        # the respawned member must serve again (supervisor + backoff +
+        # reload + precompile all inside this window)
+        doc = predict_ok(180)
+        assert doc is not None, "member did not respawn into rotation"
+        assert doc["shard_group"] == "g0"
+        out2 = subprocess.run(
+            ["pgrep", "-f", "deepfm_tpu.serve.pool --member-entry"],
+            capture_output=True, text=True,
+        )
+        new_pids = [int(p) for p in out2.stdout.split()]
+        assert new_pids and set(new_pids).isdisjoint(pids)
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+        subprocess.run(
+            ["pkill", "-f", "deepfm_tpu.serve.pool --member-entry"],
+            capture_output=True,
+        )
+
+
+def test_swapper_repairs_respawned_stale_member(pool_env):
+    """A member that dies and respawns restarts at generation 0 serving
+    the BASE servable — stale if the group ever swapped.  The
+    coordinator's repair pass must re-converge it to the group's
+    committed (version, generation) instead of leaving it stale forever
+    (found live in the verify drill)."""
+    from deepfm_tpu.serve.pool.sharded import build_serve_mesh
+    from deepfm_tpu.serve.pool.swap import GroupSwapper
+    from deepfm_tpu.serve.pool.worker import start_member
+
+    pool_env["plan"].clear()
+    h1, u1, m1 = start_member(
+        pool_env["servable"], build_serve_mesh(1, 2, group_index=0),
+        group="gr", buckets=(4, 8), max_wait_ms=1.0,
+        exchange="alltoall", source=pool_env["publish_root"],
+    )
+    port = int(u1.rsplit(":", 1)[1])
+    sw = GroupSwapper([u1], pool_env["publish_root"], group="gr")
+    try:
+        assert sw.poll_once() is True  # group at the latest version
+        assert m1.version == sw.version > 0
+        assert m1.generation == sw.generation == 1
+
+        # the respawn: a FRESH member on the same address, base weights
+        h1.shutdown()
+        h1.server_close()  # release the port for the rebind
+        m1.close()
+        deadline = time.time() + 15
+        while True:
+            try:
+                h2, u2, m2 = start_member(
+                    pool_env["servable"],
+                    build_serve_mesh(1, 2, group_index=0),
+                    group="gr", buckets=(4, 8), max_wait_ms=1.0,
+                    exchange="alltoall",
+                    source=pool_env["publish_root"], port=port,
+                )
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.2)
+        assert m2.version == 0 and m2.generation == 0  # stale
+        # no new version published -> poll_once returns False, but the
+        # repair leg re-converges the respawned member
+        assert sw.poll_once() is False
+        assert sw.status()["repairs_total"] == 1
+        assert m2.version == sw.version
+        assert m2.generation == sw.generation
+        doc = _post(f"{u2}/v1/models/deepfm:predict",
+                    {"instances": _instances(2)})
+        assert doc["model_version"] == sw.version
+        assert doc["group_generation"] == sw.generation
+        # already converged: the next poll repairs nothing
+        assert sw.poll_once() is False
+        assert sw.status()["repairs_total"] == 1
+    finally:
+        try:
+            h2.shutdown()
+            m2.close()
+        except NameError:
+            pass
+
+
+def test_swapper_rolls_back_ahead_member(pool_env):
+    """A commit whose RESPONSE was lost leaves the member one generation
+    AHEAD of the coordinator; left alone it vetoes every future group
+    swap.  The repair pass must roll it back to the committed group
+    state (review finding)."""
+    from deepfm_tpu.serve.pool.sharded import build_serve_mesh
+    from deepfm_tpu.serve.pool.swap import GroupSwapper
+    from deepfm_tpu.serve.pool.worker import start_member
+
+    pool_env["plan"].clear()
+    h, u, m = start_member(
+        pool_env["servable"], build_serve_mesh(1, 2, group_index=1),
+        group="ga", buckets=(4, 8), max_wait_ms=1.0,
+        exchange="alltoall", source=pool_env["publish_root"],
+    )
+    try:
+        sw = GroupSwapper([u], pool_env["publish_root"], group="ga")
+        assert sw.poll_once() is True
+        base_gen, base_ver = sw.generation, sw.version
+        assert (m.generation, m.version) == (base_gen, base_ver)
+
+        # the lost response: the member commits one generation further
+        # than the coordinator ever recorded
+        _post(f"{u}/admin:stage", {"version": base_ver})
+        _post(f"{u}/admin:commit",
+              {"generation": base_gen + 1, "version": base_ver})
+        assert m.generation == base_gen + 1
+
+        # the repair pass detects the AHEAD member and rolls it back
+        assert sw.poll_once() is False
+        assert m.generation == base_gen
+        assert m.version == base_ver
+        assert sw.status()["repairs_total"] == 1
+
+        # the next group swap is NOT wedged: a fresh publish commits
+        from deepfm_tpu.online.publisher import ModelPublisher
+
+        ModelPublisher(pool_env["publish_root"]).publish(
+            pool_env["cfg"], pool_env["state2"]
+        )
+        assert sw.poll_once() is True
+        assert m.generation == base_gen + 1
+        assert m.version == sw.version > base_ver
+    finally:
+        h.shutdown()
+        m.close()
