@@ -54,6 +54,14 @@ not a benchmark:
   vacuously).  The per-mode expected sets live in
   :data:`EXCHANGE_CONTRACT`.
 
+* **funnel audit** — lower the recommendation funnel's retrieval and
+  expand+rank executables (``funnel/index.py``) on the audited serve
+  meshes: transfer-guard-clean at every bucket, the index rides as
+  lowered PARAMETERS (a refresh is a jit cache hit, never a recompile),
+  per-shard ``top_k`` present, and NO collective moves a corpus-sized
+  operand — only the [B_local, K] candidate packs cross the wire (a
+  score-all-then-gather lowering is the seeded regression).
+
 * **sharded-predict audit** — lower the shard-group serving pool's
   predict (``serve.pool.sharded.build_sharded_predict_with``) on the
   audited serve meshes and hold it to the pool's contract: lowers under
@@ -964,6 +972,211 @@ def audit_sharded_predict(cfg=None, predict_builder=None) -> list[Finding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# funnel contract (recommendation funnel, deepfm_tpu/funnel)
+
+# both serve-mesh orientations, like the sharded-predict audit
+_FUNNEL_AUDIT_MESHES = ((2, 4), (4, 2))
+# corpus capacity chosen so no per-shard row count (capacity/mp) or the
+# capacity itself collides with any candidate-pack dimension (B_local, K,
+# mp*K) on the audited meshes — the corpus-collective check keys on dims
+_FUNNEL_CAPACITY = 96
+_FUNNEL_K = 8
+_FUNNEL_N = 4
+
+
+def _funnel_audit_ctx(mesh):
+    from ..funnel.index import make_funnel_context
+
+    rank_cfg = _audit_cfg()
+    query_cfg = _audit_cfg("two_tower").with_overrides(model={
+        "user_vocab_size": 499, "item_vocab_size": 499,
+        "user_field_size": 4, "item_field_size": 4,
+        "tower_layers": (32,), "tower_dim": 16, "embedding_size": 8,
+    })
+    return make_funnel_context(
+        rank_cfg, query_cfg, mesh,
+        capacity=_FUNNEL_CAPACITY, top_k=_FUNNEL_K, return_n=_FUNNEL_N,
+    )
+
+
+def audit_funnel(cfg=None, retrieve_builder=None) -> list[Finding]:
+    """The recommendation funnel's lowering contract
+    (funnel/index.py), on every audited serve mesh:
+
+    * **transfer** — the retrieval executable AND the expand+rank
+      executable lower under ``transfer_guard('disallow')`` at every
+      bucket shape: queries, ranking rows, weights and the index enter
+      only through declared arguments;
+    * **index is a parameter** — every payload leaf (query tower, rank
+      weights, index arrays) appears in the lowered signature: a baked
+      index would turn every refresh into a recompile (and pin serving
+      to one corpus snapshot forever);
+    * **per-shard top-k present** — the retrieval lowering carries the
+      ``top_k`` selection (per-shard ``lax.top_k``), i.e. candidate
+      selection happens BEFORE any collective;
+    * **no full-corpus score gather** — no collective operand carries a
+      corpus-sized dimension (capacity or capacity/model_parallel): only
+      the [B_local, K] candidate packs may cross the wire.  A lowering
+      that gathers per-shard score tensors and top-ks globally moves
+      corpus-proportional bytes per query batch — the exact failure this
+      contract exists to catch;
+    * **refresh is a cache hit** — two distinct same-spec payloads lower
+      to identical signatures and modules: an index/weights republish
+      can never recompile mid-traffic.
+
+    ``retrieve_builder(ctx)`` lets the seeded-violation tests feed a
+    contract-breaking retrieve (full-score gather, baked index) through
+    the same checks."""
+    import sys
+
+    import jax
+
+    if len(jax.devices()) < 8:
+        print(
+            "trace-audit: funnel contract SKIPPED — needs >= 8 devices "
+            "(run under JAX_PLATFORMS=cpu with "
+            "--xla_force_host_platform_device_count=8; scripts/check.sh "
+            "and the analysis CLI arrange this)",
+            file=sys.stderr,
+        )
+        return []
+    from ..funnel.index import (
+        abstract_funnel_payload,
+        build_rank_topn_with,
+        build_retrieve_with,
+    )
+    from ..serve.pool.sharded import build_serve_mesh
+
+    where = "deepfm_tpu/funnel/index.py"
+    builder = retrieve_builder or build_retrieve_with
+    out: list[Finding] = []
+    buckets = _default_buckets()
+    for dp, mp in _FUNNEL_AUDIT_MESHES:
+        mesh = build_serve_mesh(dp, mp)
+        ctx = _funnel_audit_ctx(mesh)
+        payload = abstract_funnel_payload(ctx)
+        retrieve_with = builder(ctx)
+        rank_with = build_rank_topn_with(ctx)
+        fu, f = ctx.user_fields, ctx.rank_fields
+        k = ctx.top_k
+
+        def q_args(b):
+            return (
+                jax.ShapeDtypeStruct((b, fu), jax.numpy.int64),
+                jax.ShapeDtypeStruct((b, fu), jax.numpy.float32),
+            )
+
+        def r_args(b):
+            return (
+                jax.ShapeDtypeStruct((b, f), jax.numpy.int64),
+                jax.ShapeDtypeStruct((b, f), jax.numpy.float32),
+                jax.ShapeDtypeStruct((b, k), jax.numpy.int32),
+                jax.ShapeDtypeStruct((b, k), jax.numpy.float32),
+            )
+
+        def lower_with(fn, pay, args):
+            try:
+                return fn.lower(pay, *args)
+            except TypeError:
+                # a build that dropped the payload argument (index or
+                # weights baked as constants) still lowers — the
+                # leaf-count contract below convicts it
+                return fn.lower(*args)
+
+        lowered_q, lowered_r = {}, {}
+        try:
+            with jax.transfer_guard("disallow"):
+                for b in buckets:
+                    lowered_q[b] = lower_with(retrieve_with, payload,
+                                              q_args(b))
+                    lowered_r[b] = lower_with(rank_with, payload, r_args(b))
+        except Exception as e:
+            out.append(_finding(
+                "trace-transfer",
+                f"lowering the funnel executables on mesh [{dp},{mp}] "
+                f"under transfer_guard('disallow') raised "
+                f"{type(e).__name__}: {e}",
+                hint="queries, ranking rows, weights and the index must "
+                     "enter through arguments (funnel/index.py)",
+                where=where, slug=f"funnel-{dp}x{mp}-transfer-guard",
+            ))
+            continue
+        b0 = max(buckets)
+        text = lowered_q[b0].as_text()
+        # per-shard top-k must exist — selection before any collective
+        if "top_k" not in text:
+            out.append(_finding(
+                "trace-collective",
+                f"funnel retrieve on mesh [{dp},{mp}] lowered WITHOUT a "
+                f"top_k selection — candidates are not reduced per shard "
+                f"before the merge",
+                hint="per-shard lax.top_k then candidate-pack all_gather "
+                     "(funnel/index.build_retrieve_with)",
+                where=where, slug=f"funnel-{dp}x{mp}-topk-missing",
+            ))
+        # no collective may move a corpus-sized operand
+        corpus_dims = {_FUNNEL_CAPACITY, _FUNNEL_CAPACITY // mp}
+        bad = [
+            c for c in summarize_collectives(text)
+            if any(d in corpus_dims for s in c["shapes"] for d in s)
+        ]
+        if bad:
+            out.append(_finding(
+                "trace-collective",
+                f"funnel retrieve on mesh [{dp},{mp}] moves a "
+                f"corpus-sized tensor through a collective: "
+                f"{[(c['op'], c['shapes']) for c in bad]} (corpus dims "
+                f"{sorted(corpus_dims)}) — only the [B_local, K] "
+                f"candidate packs may cross the wire",
+                hint="score and top-k per shard; gather candidate packs, "
+                     "never the score tensor (funnel/index.py)",
+                where=where, slug=f"funnel-{dp}x{mp}-corpus-gather",
+            ))
+        # payload leaves (incl. the index) must be lowered PARAMETERS
+        n_payload = len(jax.tree_util.tree_leaves(payload))
+        for name, lo, extra in (("retrieve", lowered_q[b0], 2),
+                                ("rank", lowered_r[b0], 4)):
+            n_in = len(jax.tree_util.tree_leaves(lo.in_avals))
+            if n_in != n_payload + extra:
+                out.append(_finding(
+                    "trace-recompile",
+                    f"funnel {name} on mesh [{dp},{mp}] has {n_in} input "
+                    f"leaves, expected {n_payload} payload leaves + "
+                    f"{extra} — weights or the index were baked in as "
+                    f"constants (every index refresh would recompile)",
+                    hint="pass the combined funnel payload as an argument "
+                         "(funnel/index.py)",
+                    where=where, slug=f"funnel-{dp}x{mp}-{name}-baked",
+                ))
+        # refresh == cache hit: a same-spec replacement payload must
+        # lower identically
+        payload2 = abstract_funnel_payload(ctx)
+        b1 = buckets[0]
+        lo2 = lower_with(retrieve_with, payload2, q_args(b1))
+        if lowered_q[b1].in_avals != lo2.in_avals:
+            out.append(_finding(
+                "trace-recompile",
+                f"funnel retrieve on mesh [{dp},{mp}]: a same-spec "
+                f"replacement payload changed the input signature — an "
+                f"index/weights republish would MISS the jit cache and "
+                f"recompile mid-traffic",
+                hint="keep the payload a plain argument pytree "
+                     "(funnel/index.build_retrieve_with)",
+                where=where, slug=f"funnel-{dp}x{mp}-swap-signature",
+            ))
+        elif lowered_q[b1].as_text() != lo2.as_text():
+            out.append(_finding(
+                "trace-recompile",
+                f"funnel retrieve on mesh [{dp},{mp}]: same-spec payloads "
+                f"lowered to different modules — payload identity (a "
+                f"version) leaked into the executable",
+                hint="no host reads of the payload inside the retrieve",
+                where=where, slug=f"funnel-{dp}x{mp}-swap-module",
+            ))
+    return out
+
+
 def run_trace_audit(cfg=None) -> list[Finding]:
     """All engine-2 audits against the real entrypoints (abstract values
     only; no step executes).  Importing jax is the price of admission —
@@ -975,4 +1188,5 @@ def run_trace_audit(cfg=None) -> list[Finding]:
     findings.extend(audit_paged_step(cfg))
     findings.extend(audit_spmd_exchange(cfg))
     findings.extend(audit_sharded_predict(cfg))
+    findings.extend(audit_funnel(cfg))
     return findings

@@ -360,6 +360,13 @@ class RunConfig:
     serve_retry_limit: int = 2
     serve_health_interval_secs: float = 1.0
     serve_eject_after: int = 2
+    # recommendation funnel (deepfm_tpu/funnel; task_type=serve over a
+    # funnel servable — sharded top-K retrieval into live-weight ranking):
+    # candidates retrieved per user and ranked items returned per user
+    # (0 = the servable's funnel.json defaults).  funnel_top_k > 0 also
+    # engages the funnel geometry validation in Config.__post_init__.
+    funnel_top_k: int = 0
+    funnel_return_n: int = 0
     # online continuous training (task_type=online-train, online/trainer.py):
     # publish a servable version every N optimizer steps (0 = only at
     # stream end); stop after N batches (0 = unbounded); stop after N
@@ -483,6 +490,57 @@ class Config:
                     f"miss fetch (window {h - max(1, h // 16)} < "
                     f"batch_size*field_size={bf})"
                 )
+        # 4. recommendation funnel geometry (deepfm_tpu/funnel): lax.top_k
+        # cannot select more rows than one index shard holds (the retrieve
+        # executable would be unbuildable), and a user's K-candidate rank
+        # fan-out must land on a precompiled serving bucket — K over the
+        # largest bucket means even a lone recommend row cannot dispatch
+        # through any single rank executable (the pigeonhole), while a
+        # bucket padding to >= 2x K halves the rank throughput silently
+        # (the wasteful case).  Runtime re-validates against the actual
+        # serve mesh (funnel/index.make_funnel_context); this is the
+        # config-time gate on the declared topology.
+        r = self.run
+        if r.funnel_top_k > 0:
+            k = r.funnel_top_k
+            if r.funnel_return_n > k:
+                raise ValueError(
+                    f"funnel_return_n={r.funnel_return_n} exceeds "
+                    f"funnel_top_k={k} — cannot return more ranked items "
+                    f"than candidates retrieved"
+                )
+            item_vocab = m.item_vocab_size or m.feature_size
+            mp_serve = (r.serve_group_model_parallel if r.serve_groups > 0
+                        else mp)
+            if mp_serve > 0:
+                per_shard = -(-item_vocab // mp_serve)
+                if k > per_shard:
+                    raise ValueError(
+                        f"funnel_top_k={k} exceeds the (padded) per-shard "
+                        f"item vocab {per_shard} (item vocab {item_vocab} "
+                        f"row-sharded over model_parallel={mp_serve}) — "
+                        f"per-shard lax.top_k cannot select more rows than "
+                        f"a shard holds"
+                    )
+            buckets = _parse_int_list(r.serve_buckets)
+            if buckets:
+                if k > max(buckets):
+                    raise ValueError(
+                        f"funnel_top_k={k} exceeds the largest serve "
+                        f"bucket {max(buckets)}: one user's K ranking rows "
+                        f"cannot fit any precompiled dispatch "
+                        f"(run.serve_buckets={r.serve_buckets!r}) — raise "
+                        f"the bucket set or lower funnel_top_k"
+                    )
+                fit = min(b for b in buckets if b >= k)
+                if fit >= 2 * k:
+                    warnings.warn(
+                        f"funnel_top_k={k} pads to serve bucket {fit} "
+                        f"(>= 2x): every user's candidate set fills under "
+                        f"half a rank dispatch — add a ~{k}-row bucket to "
+                        f"run.serve_buckets or raise funnel_top_k",
+                        stacklevel=2,
+                    )
 
     # ---- overrides ------------------------------------------------------
 

@@ -96,6 +96,17 @@ def build_parser() -> argparse.ArgumentParser:
              "(0 = auto: member host devices / group data_parallel)",
     )
     p.add_argument(
+        "--funnel_top_k", type=int,
+        help="task_type=serve over a funnel servable (deepfm_tpu/funnel): "
+             "candidates retrieved per user before ranking "
+             "(0 = the servable's funnel.json default)",
+    )
+    p.add_argument(
+        "--funnel_return_n", type=int,
+        help="funnel serving: ranked items returned per user "
+             "(0 = the servable's funnel.json default)",
+    )
+    p.add_argument(
         "--set",
         action="append",
         default=[],
@@ -130,6 +141,8 @@ _FLAG_MAP = {
     "model_parallel": ("mesh", "model_parallel"),
     "serve_groups": ("run", "serve_groups"),
     "serve_group_mp": ("run", "serve_group_model_parallel"),
+    "funnel_top_k": ("run", "funnel_top_k"),
+    "funnel_return_n": ("run", "funnel_return_n"),
 }
 
 

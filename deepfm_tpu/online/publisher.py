@@ -82,6 +82,11 @@ class Manifest:
     cursor: dict | None = None
     watermark: float = 0.0
     extra: dict = field(default_factory=dict)
+    # funnel versions (funnel/publish.py) carry their retrieval index
+    # alongside the ranking weights: {"items", "dim", "sha256",
+    # "query_param_hash"} — ONE manifest commits both, so retrieval and
+    # ranking can never skew versions.  None for plain CTR versions.
+    index: dict | None = None
 
     def to_json(self) -> str:
         return json.dumps(asdict(self), indent=2)

@@ -15,6 +15,7 @@ computed, never the candidate pool.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Callable, NamedTuple
 
 import jax
@@ -27,6 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.config import Config
 from ..models.two_tower import (
     apply_two_tower,
+    encode_tower,
     in_batch_softmax_loss,
     init_two_tower,
     item_vocab,
@@ -40,6 +42,29 @@ from .mesh import DATA_AXIS, MODEL_AXIS, mesh_shape
 from .spmd import _pmean_grads, _sharded_penalty, padded_vocab
 
 _RETRIEVAL_TABLES = ("user_embedding", "item_embedding")
+
+
+# -- inference-path encoder pair --------------------------------------------
+#
+# The tower forward exists ONCE: these apply-only entry points (no loss, no
+# optimizer, params as arguments) are shared by the funnel index builder
+# (funnel/index.build_index), the funnel's sharded retrieval executable
+# (funnel/index.build_retrieve_with encodes queries through the same
+# encode_tower), and the training parity tests — so serving, indexing, and
+# training can never drift onto different tower math.
+
+@partial(jax.jit, static_argnames=("cfg",))
+def encode_queries(params, user_ids, user_vals, *, cfg) -> jax.Array:
+    """Encode query users: ``(params, [B, Fu] ids, [B, Fu] vals) ->
+    [B, D]`` L2-normalized embeddings (``cfg`` is a ModelConfig)."""
+    return encode_tower(params, user_ids, user_vals, cfg=cfg, side="user")
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def encode_items(params, item_ids, item_vals, *, cfg) -> jax.Array:
+    """Encode corpus items: ``(params, [B, Fi] ids, [B, Fi] vals) ->
+    [B, D]`` L2-normalized embeddings (``cfg`` is a ModelConfig)."""
+    return encode_tower(params, item_ids, item_vals, cfg=cfg, side="item")
 
 
 class RetrievalContext(NamedTuple):

@@ -43,6 +43,10 @@ def _member_argv(args, group: str, index: int, port: int) -> list[str]:
         argv += ["--exchange", args.exchange]
     if args.reload_url:
         argv += ["--reload-url", args.reload_url]
+    if args.funnel_top_k:
+        argv += ["--funnel-top-k", str(args.funnel_top_k)]
+    if args.funnel_return_n:
+        argv += ["--funnel-return-n", str(args.funnel_return_n)]
     return argv
 
 
@@ -103,6 +107,8 @@ def _run_member(args) -> int:
         max_wait_ms=args.max_wait_ms,
         exchange=args.exchange or None,
         source=args.reload_url or None,
+        funnel_top_k=args.funnel_top_k,
+        funnel_return_n=args.funnel_return_n,
     )
     return 0
 
@@ -134,6 +140,12 @@ def main(argv: list[str] | None = None) -> int:
                     help="publish root: each group gets a group-atomic "
                          "swap coordinator polling it")
     ap.add_argument("--reload-interval", type=float, default=2.0)
+    ap.add_argument("--funnel-top-k", type=int, default=0,
+                    help="funnel servables: candidates retrieved per user "
+                         "(0 = the servable's funnel.json default)")
+    ap.add_argument("--funnel-return-n", type=int, default=0,
+                    help="funnel servables: ranked items returned per "
+                         "user (0 = the servable's funnel.json default)")
     ap.add_argument("--retry-limit", type=int, default=2)
     ap.add_argument("--eject-after", type=int, default=2)
     ap.add_argument("--health-interval", type=float, default=1.0)

@@ -272,11 +272,16 @@ class Router:
             )
         return head + [g for g in healthy if g not in head]
 
-    def handle_predict(self, body: dict) -> tuple[int, dict]:
-        """Route one predict; returns ``(http_status, response_doc)``.
-        The member's response document passes through untouched (it
-        already carries predictions, model_version, shard_group and
-        group_generation) plus a ``router`` attribution section."""
+    def handle_predict(self, body: dict,
+                       path: str | None = None) -> tuple[int, dict]:
+        """Route one predict (or funnel recommend — ``path`` overrides
+        the default ``:predict`` member route; same pinning, ejection and
+        retry discipline); returns ``(http_status, response_doc)``.  The
+        member's response document passes through untouched (it already
+        carries predictions — or the funnel's items + index_version —
+        model_version, shard_group and group_generation) plus a
+        ``router`` attribution section."""
+        target = path or f"/v1/models/{self.model_name}:predict"
         key = self.request_key(body)
         rows = len(body.get("instances", []))
         plan = self._plan(key)
@@ -308,8 +313,7 @@ class Router:
                 if gen is not None:
                     headers["X-Pinned-Generation"] = str(gen)
                 req = urllib.request.Request(
-                    f"{m.url}/v1/models/{self.model_name}:predict",
-                    data=payload, headers=headers,
+                    f"{m.url}{target}", data=payload, headers=headers,
                 )
                 t0 = time.perf_counter()
                 with self._lock:
@@ -418,6 +422,7 @@ class Router:
 
 def make_router_handler(router: Router):
     predict_path = f"/v1/models/{router.model_name}:predict"
+    recommend_path = "/v1/recommend"   # funnel members (funnel/serve.py)
     status_path = f"/v1/models/{router.model_name}"
 
     class RouterHandler(BaseHTTPRequestHandler):
@@ -448,7 +453,7 @@ def make_router_handler(router: Router):
                 self._send(404, {"error": f"unknown path {self.path!r}"})
 
         def do_POST(self):  # noqa: N802
-            if self.path != predict_path:
+            if self.path not in (predict_path, recommend_path):
                 return self._send(404,
                                   {"error": f"unknown path {self.path!r}"})
             try:
@@ -458,7 +463,11 @@ def make_router_handler(router: Router):
             except Exception as e:
                 return self._send(400,
                                   {"error": f"{type(e).__name__}: {e}"})
-            code, doc = router.handle_predict(body)
+            code, doc = router.handle_predict(
+                body,
+                path=recommend_path if self.path == recommend_path
+                else None,
+            )
             self._send(code, doc)
 
         def log_message(self, fmt, *args):

@@ -89,12 +89,37 @@ class GroupMember:
         exchange: str | None = None,
         source: str | None = None,
         staging_dir: str | None = None,
+        funnel_top_k: int = 0,
+        funnel_return_n: int = 0,
         precompile: bool = True,
     ):
-        predict, predict_with, holder, ctx = load_sharded_servable(
-            servable_dir, mesh, exchange=exchange
-        )
-        dp = ctx.cfg.mesh.data_parallel
+        from ...funnel.publish import is_funnel_servable
+        from ...parallel.mesh import mesh_shape
+
+        self.funnel = is_funnel_servable(os.path.abspath(servable_dir))
+        if self.funnel:
+            # a funnel member serves /v1/recommend: the retrieval index
+            # row-shards over this member's mesh and ranking runs the
+            # live weights — staged/committed as ONE payload through the
+            # same group-atomic swap protocol as CTR weights
+            from ...funnel.serve import FunnelScorer
+
+            self._scorer = FunnelScorer(
+                servable_dir, mesh, top_k=funnel_top_k,
+                return_n=funnel_return_n, buckets=buckets,
+                max_wait_ms=max_wait_ms, max_queue_rows=max_queue_rows,
+                precompile=False, name=f"recommend[{group}/{member}]",
+            )
+            ctx = self._scorer.ctx
+            holder = self._scorer.holder
+            predict_with = None
+            dp, _ = mesh_shape(mesh)
+        else:
+            self._scorer = None
+            predict, predict_with, holder, ctx = load_sharded_servable(
+                servable_dir, mesh, exchange=exchange
+            )
+            dp = ctx.cfg.mesh.data_parallel
         bad = [b for b in buckets if int(b) % dp != 0]
         if bad:
             raise ValueError(
@@ -117,12 +142,16 @@ class GroupMember:
             f"deepfm_pool_{os.getpid()}_{group}_{member}",
         )
         os.makedirs(self._staging, exist_ok=True)
-        self.engine = MicroBatcher(
-            predict, ctx.cfg.model.field_size, buckets=buckets,
-            max_wait_ms=max_wait_ms, max_queue_rows=max_queue_rows,
-            name=f"predict[{group}/{member}]",
-        )
-        self._canary = _canary_batch(ctx.cfg, int(sorted(buckets)[0]))
+        if self.funnel:
+            self.engine = self._scorer.engine
+            self._canary = None  # the FunnelScorer canaries its own stages
+        else:
+            self.engine = MicroBatcher(
+                predict, ctx.cfg.model.field_size, buckets=buckets,
+                max_wait_ms=max_wait_ms, max_queue_rows=max_queue_rows,
+                name=f"predict[{group}/{member}]",
+            )
+            self._canary = _canary_batch(ctx.cfg, int(sorted(buckets)[0]))
         self._lock = threading.Lock()
         self.generation = 0
         self._staged = None          # (payload, manifest)
@@ -132,7 +161,10 @@ class GroupMember:
         self.rollbacks_total = 0
         self.stage_failures_total = 0
         if precompile:
-            self.compile_secs = self.engine.precompile()
+            # the funnel scorer brackets its warm-up so compile time never
+            # lands in the serving metrics
+            self.compile_secs = (self._scorer.precompile() if self.funnel
+                                 else self.engine.precompile())
 
     # -- serving surface ----------------------------------------------------
     @property
@@ -156,6 +188,22 @@ class GroupMember:
         """The ``group_status`` document (schema: serve/server.py
         make_handler) — predict responses, ``/readyz``, and the
         ``router`` metrics section all serve this."""
+        if self.funnel:
+            from ...funnel.index import funnel_wire_bytes_est
+            from ...parallel.mesh import mesh_shape
+
+            dp, mp = mesh_shape(self.ctx.mesh)
+            return {
+                "shard_group": self.group,
+                "member": self.member,
+                "group_generation": self.generation,
+                "exchange": "funnel",   # candidate-pack all_gather merge
+                "mesh": [dp, mp],
+                "exchange_wire_bytes_est": funnel_wire_bytes_est(
+                    self.ctx, max(self.engine.buckets)
+                ),
+                "skew_aborts_total": self.skew_aborts_total,
+            }
         cfg = self.ctx.cfg
         return {
             "shard_group": self.group,
@@ -193,6 +241,23 @@ class GroupMember:
                 "no publish root: member has no configured source and the "
                 "stage request named none"
             )
+        if self.funnel:
+            # the FunnelScorer owns funnel staging: resolve + verify BOTH
+            # hashes (rank weights + index) + canary both stages; the
+            # staged object is the combined payload, so the group commit
+            # below swaps weights and index atomically
+            try:
+                payload, manifest = self._scorer.stage_version(
+                    root, int(version), self._staging
+                )
+            except Exception:
+                with self._lock:
+                    self.stage_failures_total += 1
+                raise
+            with self._lock:
+                self._staged = (payload, manifest)
+                return {"staged_version": manifest.version,
+                        "group_generation": self.generation}
         try:
             manifest, local = resolve_version(root, int(version),
                                               self._staging)
@@ -267,7 +332,7 @@ class GroupMember:
                     f"the member's {self.generation}"
                 )
             prev = (self._holder.get(), self._holder.version,
-                    self.generation)
+                    self.generation, self._holder.manifest)
             # adopt the generation BEFORE the payload swap: the swap
             # installs the new weights immediately and then blocks on the
             # drain (up to drain_timeout_secs) — a request pinned to the
@@ -291,10 +356,13 @@ class GroupMember:
         with self._lock:
             if self._prev is None:
                 raise SwapProtocolError("nothing to roll back")
-            payload, ver, gen = self._prev
-            # same ordering as commit: generation first, then the payload
+            payload, ver, gen, manifest = self._prev
+            # same ordering as commit: generation first, then the payload.
+            # The manifest rides along: a rolled-back funnel member must
+            # keep reporting the LIVE index's version/occupancy, not the
+            # boot servable's
             self.generation = gen
-            self._holder.swap(payload, version=ver)
+            self._holder.swap(payload, version=ver, manifest=manifest)
             self._prev = None
             self.rollbacks_total += 1
             return {"group_generation": self.generation,
@@ -324,6 +392,12 @@ def make_member_handler(member: GroupMember, model_name: str):
         f"/v1/models/{model_name}:predict",
         f"/v1/models/{model_name}:predict_binary",
     }
+    if getattr(member, "funnel", False):
+        # the funnel scoring route rides the same generation-skew gate:
+        # a pinned recommend must never score across a group commit
+        from ...funnel.serve import RECOMMEND_PATH
+
+        predict_paths = predict_paths | {RECOMMEND_PATH}
     admin: dict[str, Callable[[dict], dict]] = {
         "/admin:stage": lambda b: member.stage(
             b["version"], b.get("source")
@@ -361,7 +435,26 @@ def make_member_handler(member: GroupMember, model_name: str):
                             "shard_group": member.group,
                             "group_generation": member.generation,
                         })
+                if (getattr(member, "funnel", False)
+                        and self.path == "/v1/recommend"):
+                    return self._do_recommend()
             return super().do_POST()
+
+        def _do_recommend(self):
+            from ...funnel.serve import handle_recommend
+
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(length))
+            except Exception as e:
+                return self._send(400,
+                                  {"error": f"{type(e).__name__}: {e}"})
+            code, doc = handle_recommend(member._scorer, req)
+            if code == 200:
+                # group attribution alongside the atomic version pair
+                doc["shard_group"] = member.group
+                doc["group_generation"] = member.generation
+            self._send(code, doc)
 
         def _drain_body(self):
             # an early reject must still consume the request body, or the

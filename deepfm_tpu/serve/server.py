@@ -408,6 +408,12 @@ def make_handler(scorer, model_name: str, reload_status=None,
                 if "paging" not in snap and hasattr(
                         scorer, "paging_snapshot"):
                     snap["paging"] = scorer.paging_snapshot()
+                # funnel engines (deepfm_tpu/funnel FunnelScorer) publish
+                # retrieval latency, candidates/s, index version/occupancy
+                # and the merge-overflow count — same hook pattern
+                if "funnel" not in snap and hasattr(
+                        scorer, "funnel_snapshot"):
+                    snap["funnel"] = scorer.funnel_snapshot()
                 if group_status is not None:
                     snap["router"] = group_status()
                 self._send(200, snap)
@@ -528,6 +534,8 @@ def serve_pool(
     buckets=(8, 32, 128, 512), max_wait_ms: float = 2.0,
     max_queue_rows: int | None = None, item_corpus: str | None = None,
     reload_url: str | None = None, reload_interval_secs: float = 2.0,
+    funnel_top_k: int = 0, funnel_return_n: int = 0,
+    funnel_data_parallel: int = 1, funnel_model_parallel: int = 0,
     max_restarts: int = 10,
     ready: threading.Event | None = None,
 ) -> None:
@@ -581,6 +589,10 @@ def serve_pool(
                     # coordination (briefly mixed versions during a rollout)
                     reload_url=reload_url,
                     reload_interval_secs=reload_interval_secs,
+                    funnel_top_k=funnel_top_k,
+                    funnel_return_n=funnel_return_n,
+                    funnel_data_parallel=funnel_data_parallel,
+                    funnel_model_parallel=funnel_model_parallel,
                 )
             except BaseException:
                 # the traceback is the only diagnostic a crash-looping
@@ -665,24 +677,53 @@ def serve_forever(
     max_wait_ms: float = 2.0, max_queue_rows: int | None = None,
     item_corpus: str | None = None,
     reload_url: str | None = None, reload_interval_secs: float = 2.0,
+    funnel_top_k: int = 0, funnel_return_n: int = 0,
+    funnel_data_parallel: int = 1, funnel_model_parallel: int = 0,
     ready: threading.Event | None = None,
 ) -> None:
     """Serve whichever servable lives at ``servable_dir``: CTR models get
     ``:predict``; two-tower retrieval gets ``:encode_user``/``:encode_item``
-    and — with ``item_corpus`` — ``:retrieve``.  Both ride the bucketed
-    micro-batching engine (serve/batcher.py), precompiled before the
-    socket opens so the first request never pays a compile.
+    and — with ``item_corpus`` — ``:retrieve``; funnel servables
+    (``funnel.json`` marker, deepfm_tpu/funnel) get ``/v1/recommend`` —
+    sharded top-K retrieval into live-weight ranking as one
+    version-consistent system.  All ride the bucketed micro-batching
+    engine (serve/batcher.py), precompiled before the socket opens so the
+    first request never pays a compile.
 
     ``reload_url`` (a publish root — local dir or object URL written by
     ``online/publisher.py``) turns on zero-downtime hot weight reload: the
     params ride the precompiled bucket executables as arguments, a
     HotSwapper polls for new versions every ``reload_interval_secs``, and
-    swaps pass canary + drain before traffic sees them (serve/reload.py)."""
+    swaps pass canary + drain before traffic sees them (serve/reload.py).
+    For funnel servables the reload root must hold FunnelPublisher
+    versions: ranking weights and the retrieval index swap as ONE payload
+    (funnel/serve.py FunnelSwapper)."""
     import os
 
+    from ..funnel.publish import is_funnel_servable
     from .export import _load_config, load_retrieval_servable, load_servable
 
     buckets = _parse_buckets(buckets)
+    if is_funnel_servable(os.path.abspath(servable_dir)):
+        from ..funnel.serve import serve_funnel
+
+        if item_corpus:
+            raise ValueError(
+                "--item-corpus applies to two-tower servables; a funnel "
+                "servable carries its own published index"
+            )
+        serve_funnel(
+            os.path.abspath(servable_dir), port=port, host=host,
+            model_name=model_name, buckets=buckets,
+            max_wait_ms=max_wait_ms, max_queue_rows=max_queue_rows,
+            reload_url=reload_url,
+            reload_interval_secs=reload_interval_secs,
+            top_k=funnel_top_k, return_n=funnel_return_n,
+            data_parallel=funnel_data_parallel,
+            model_parallel=funnel_model_parallel,
+            ready=ready,
+        )
+        return
     cfg = _load_config(os.path.abspath(servable_dir))
     if reload_url and cfg.model.model_name == "two_tower":
         raise ValueError(
@@ -884,6 +925,26 @@ def main(argv: list[str] | None = None) -> int:
         "--reload-interval", type=float, default=2.0,
         help="seconds between manifest polls when --reload-url is set",
     )
+    ap.add_argument(
+        "--funnel-top-k", type=int, default=0,
+        help="funnel servables: candidates retrieved per user "
+             "(0 = the servable's funnel.json default)",
+    )
+    ap.add_argument(
+        "--funnel-return-n", type=int, default=0,
+        help="funnel servables: ranked items returned per user "
+             "(0 = the servable's funnel.json default)",
+    )
+    ap.add_argument(
+        "--funnel-dp", type=int, default=1,
+        help="funnel mesh: request-batch shard factor (buckets must "
+             "divide by it)",
+    )
+    ap.add_argument(
+        "--funnel-mp", type=int, default=0,
+        help="funnel mesh: index row-shard factor "
+             "(0 = remaining devices / funnel-dp)",
+    )
     args = ap.parse_args(argv)
     if args.stdin:
         score_stdin(args.servable, batch_size=args.batch_size,
@@ -898,6 +959,10 @@ def main(argv: list[str] | None = None) -> int:
             item_corpus=args.item_corpus,
             reload_url=args.reload_url,
             reload_interval_secs=args.reload_interval,
+            funnel_top_k=args.funnel_top_k,
+            funnel_return_n=args.funnel_return_n,
+            funnel_data_parallel=args.funnel_dp,
+            funnel_model_parallel=args.funnel_mp,
         )
         return 0
     serve_forever(
@@ -907,6 +972,10 @@ def main(argv: list[str] | None = None) -> int:
         item_corpus=args.item_corpus,
         reload_url=args.reload_url,
         reload_interval_secs=args.reload_interval,
+        funnel_top_k=args.funnel_top_k,
+        funnel_return_n=args.funnel_return_n,
+        funnel_data_parallel=args.funnel_dp,
+        funnel_model_parallel=args.funnel_mp,
     )
     return 0
 

@@ -753,6 +753,10 @@ def run_task(cfg: Config):
                 argv += ["--reload-url", cfg.run.serve_reload_url,
                          "--reload-interval",
                          str(cfg.run.serve_reload_interval_secs)]
+            if cfg.run.funnel_top_k:
+                argv += ["--funnel-top-k", str(cfg.run.funnel_top_k)]
+            if cfg.run.funnel_return_n:
+                argv += ["--funnel-return-n", str(cfg.run.funnel_return_n)]
             pool_main(argv)
             return None
         if cfg.run.serve_workers > 1:
@@ -766,6 +770,8 @@ def run_task(cfg: Config):
                 item_corpus=cfg.run.serve_item_corpus or None,
                 reload_url=cfg.run.serve_reload_url or None,
                 reload_interval_secs=cfg.run.serve_reload_interval_secs,
+                funnel_top_k=cfg.run.funnel_top_k,
+                funnel_return_n=cfg.run.funnel_return_n,
             )
             return None
         serve_forever(
@@ -777,6 +783,8 @@ def run_task(cfg: Config):
             item_corpus=cfg.run.serve_item_corpus or None,
             reload_url=cfg.run.serve_reload_url or None,
             reload_interval_secs=cfg.run.serve_reload_interval_secs,
+            funnel_top_k=cfg.run.funnel_top_k,
+            funnel_return_n=cfg.run.funnel_return_n,
         )
         return None
     if cfg.model.model_name == "two_tower":

@@ -23,10 +23,16 @@ fi
 # (audit_sharded_predict): the serving pool's shard-group predict must
 # lower with the all_to_all exchange (no dense row tensor outside the
 # fallback arm), cover every admissible per-group dispatch size with a
-# precompiled bucket, and keep group swaps jit cache hits.  Seeded
-# violations in tests/test_analysis.py (smuggled transfer, dense-row leak,
-# off-bucket/indivisible shape, baked mixed-generation payload) prove each
-# contract actually catches its regression.
+# precompiled bucket, and keep group swaps jit cache hits — and the FUNNEL
+# contract (audit_funnel): the recommendation funnel's retrieve and
+# expand+rank executables must lower transfer-guard-clean with the index
+# as lowered parameters (a refresh is a cache hit), per-shard top-k
+# present, and no collective moving a corpus-sized operand (only the
+# [B_local, K] candidate packs cross the wire).  Seeded violations in
+# tests/test_analysis.py (smuggled transfer, dense-row leak,
+# off-bucket/indivisible shape, baked mixed-generation payload,
+# full-corpus score gather, baked index) prove each contract actually
+# catches its regression.
 exec env JAX_PLATFORMS=cpu \
     XLA_FLAGS="${XLA_FLAGS:+$XLA_FLAGS }--xla_force_host_platform_device_count=8" \
     python -m deepfm_tpu.analysis deepfm_tpu \

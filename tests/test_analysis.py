@@ -1237,3 +1237,110 @@ class TestShardedPredictContract:
         findings = audit_sharded_predict(predict_builder=baked_builder)
         assert any(f.rule == "trace-recompile"
                    and "baked" in f.message for f in findings), findings
+
+
+class TestFunnelContract:
+    """The recommendation funnel's trace contract
+    (trace_audit.audit_funnel, wired into scripts/check.sh via
+    run_trace_audit): transfer-guard-clean retrieve+expand+rank, index
+    leaves as lowered parameters, per-shard top-k present, no
+    corpus-sized collective operand."""
+
+    def test_real_funnel_holds_the_contract(self):
+        from deepfm_tpu.analysis.trace_audit import audit_funnel
+
+        findings = audit_funnel()
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_seeded_full_corpus_gather_caught(self):
+        """The score-all-then-merge lowering the contract forbids: each
+        shard all-gathers its FULL per-shard score tensor and top-ks
+        globally — corpus-proportional wire bytes per query batch."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from deepfm_tpu.analysis.trace_audit import audit_funnel
+        from deepfm_tpu.core.compat import shard_map
+        from deepfm_tpu.models.two_tower import encode_tower
+        from deepfm_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+        def gather_builder(ctx):
+            qcfg = ctx.query_cfg.model
+            k = ctx.top_k
+
+            def local(payload, uids, uvals):
+                u = encode_tower(payload["query"], uids, uvals,
+                                 cfg=qcfg, side="user")
+                emb = payload["index"]["item_emb"]
+                iid = payload["index"]["item_ids"]
+                scores = u @ emb.T
+                scores = jnp.where(iid[None, :] >= 0, scores, -jnp.inf)
+                # the violation: the [B_local, rows_local] score tensor
+                # (and the corpus id vector) cross the wire
+                all_s = lax.all_gather(scores, MODEL_AXIS, axis=1,
+                                       tiled=True)
+                all_i = lax.all_gather(iid, MODEL_AXIS, axis=0,
+                                       tiled=True)
+                s, li = lax.top_k(all_s, k)
+                return s, jnp.take(all_i, li, axis=0)
+
+            mapped = shard_map(
+                local, mesh=ctx.mesh,
+                in_specs=(ctx.payload_specs, P(DATA_AXIS, None),
+                          P(DATA_AXIS, None)),
+                out_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
+                check_vma=False,
+            )
+            return jax.jit(lambda p, i, v: mapped(p, i, v))
+
+        findings = audit_funnel(retrieve_builder=gather_builder)
+        assert any(f.rule == "trace-collective"
+                   and "corpus-sized" in f.message
+                   for f in findings), findings
+
+    def test_seeded_baked_index_caught(self):
+        """A retrieve whose index (and weights) compile in as constants:
+        every index refresh would be a recompile, and serving would pin
+        to one corpus snapshot.  The leaf-count contract convicts it."""
+        import jax
+        import numpy as np
+
+        from deepfm_tpu.analysis.trace_audit import audit_funnel
+        from deepfm_tpu.funnel.index import build_retrieve_with
+        from deepfm_tpu.models.base import get_model
+        from deepfm_tpu.models.two_tower import init_two_tower
+
+        def baked_builder(ctx):
+            real = build_retrieve_with(ctx)
+            model = get_model(ctx.rank_cfg.model)
+            rp, rs = model.init(jax.random.PRNGKey(0), ctx.rank_cfg.model)
+            qp, _ = init_two_tower(jax.random.PRNGKey(1),
+                                   ctx.query_cfg.model)
+            d = ctx.query_cfg.model.tower_dim
+            concrete = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(x, s),
+                {
+                    "query": {k: qp[k] for k in ("user_embedding",
+                                                 "user_tower")},
+                    "rank": {"params": rp, "model_state": rs},
+                    "index": {
+                        "item_ids": np.arange(ctx.capacity,
+                                              dtype=np.int32),
+                        "item_emb": np.zeros((ctx.capacity, d),
+                                             np.float32),
+                    },
+                },
+                ctx.payload_shardings,
+            )
+
+            @jax.jit
+            def retrieve_baked(uids, uvals):
+                return real(concrete, uids, uvals)
+
+            return retrieve_baked
+
+        findings = audit_funnel(retrieve_builder=baked_builder)
+        assert any(f.rule == "trace-recompile"
+                   and "baked" in f.message for f in findings), findings

@@ -135,6 +135,8 @@ def test_serve_task_dispatch(monkeypatch):
         "item_corpus": None,
         "reload_url": None,  # run.serve_reload_url="" -> hot reload off
         "reload_interval_secs": 2.0,
+        "funnel_top_k": 0,   # 0 = the servable's funnel.json defaults
+        "funnel_return_n": 0,
     }
 
 

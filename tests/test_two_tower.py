@@ -66,7 +66,8 @@ def _batch(key, b, cfg=CFG):
 
 def test_tower_outputs_normalized():
     params, _ = init_two_tower(jax.random.PRNGKey(0), CFG.model)
-    towers = apply_two_tower(params, _batch(jax.random.PRNGKey(1), 9), cfg=CFG.model)
+    batch = _batch(jax.random.PRNGKey(1), 9)
+    towers = apply_two_tower(params, batch, cfg=CFG.model)
     assert towers.user.shape == (9, CFG.model.tower_dim)
     assert towers.item.shape == (9, CFG.model.tower_dim)
     np.testing.assert_allclose(
@@ -74,6 +75,21 @@ def test_tower_outputs_normalized():
     )
     np.testing.assert_allclose(
         np.linalg.norm(np.asarray(towers.item), axis=1), 1.0, rtol=1e-5
+    )
+    # the inference-path encoder pair (shared with the funnel index
+    # builder, parallel/retrieval.py) IS the training forward: identical
+    # outputs, not merely close ones
+    from deepfm_tpu.parallel.retrieval import encode_items, encode_queries
+
+    np.testing.assert_array_equal(
+        np.asarray(encode_queries(params, batch["user_ids"],
+                                  batch["user_vals"], cfg=CFG.model)),
+        np.asarray(towers.user),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(encode_items(params, batch["item_ids"],
+                                batch["item_vals"], cfg=CFG.model)),
+        np.asarray(towers.item),
     )
 
 
