@@ -362,6 +362,22 @@ def run_variant(name: str) -> None:
 
 
 def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == "--elastic":
+        # the elastic chaos drill (benchmarks/elastic_drill.py): shrink
+        # [2,4]→[1,4] and grow back mid-run under serving load; emits
+        # docs/BENCH_ELASTIC.json (reshard wall-time, steps lost, serving
+        # error counts, loss continuity).  CPU virtual mesh by design —
+        # the drill measures the robustness layer, not chip throughput.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        import elastic_drill
+
+        elastic_drill.main()
+        return
     if len(sys.argv) > 2 and sys.argv[1] == "--variant":
         # child: platform was resolved by the parent and passed via env
         run_variant(sys.argv[2])

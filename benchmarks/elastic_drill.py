@@ -1,0 +1,439 @@
+"""Elastic chaos drill: shrink the training mesh [2,4]→[1,4] mid-run and
+grow it back, while the serving pool consumes the publishes under client
+load — the acceptance drill for the elastic subsystem (deepfm_tpu/elastic)
+and the source of ``docs/BENCH_ELASTIC.json``.
+
+What it measures and asserts:
+
+* **reshard wall-time** — detect→drain→commit→replan→restore→recompile,
+  per topology change;
+* **steps lost** — optimizer steps replayed from the last commit (zero
+  with drain+commit; the commit-cadence tail without it);
+* **exactly-once** — the cursor lineage is strictly increasing and covers
+  every event batch exactly once;
+* **loss continuity** — per-step training loss of the elastic run tracks
+  an uninterrupted fixed-mesh baseline within float-reassociation
+  tolerance (a double-applied or dropped batch diverges far beyond it);
+* **serving continuity** — a shard-group member behind the router, fed by
+  a GroupSwapper polling the drill's publish root, serves concurrent
+  clients across the shrink: 0 failed predicts, 0 mixed-version scores
+  (every response's (generation, version) pair is a committed state).
+
+Run directly (``python benchmarks/elastic_drill.py``) or via
+``python bench.py --elastic``; the slow-marked chaos test
+(tests/test_elastic_chaos.py) drives ``run_drill`` with assertions and
+scripts/check.sh wires it as the elastic gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+FEATURE, FIELD = 64, 5
+LOSS_TOLERANCE = 5e-3
+
+
+def _cfg(root: str, *, batch: int, drain_commit: bool):
+    from deepfm_tpu.core.config import Config
+
+    return Config.from_dict({
+        "model": {
+            "feature_size": FEATURE,
+            "field_size": FIELD,
+            "embedding_size": 4,
+            "deep_layers": (8,),
+            "dropout_keep": (1.0,),
+            "compute_dtype": "float32",
+        },
+        "optimizer": {"learning_rate": 0.01,
+                      "lazy_embedding_updates": True},
+        "data": {
+            "training_data_dir": os.path.join(root, "stream"),
+            "batch_size": batch,
+        },
+        "run": {
+            "model_dir": os.path.join(root, "ckpt"),
+            "servable_model_dir": os.path.join(root, "publish"),
+            "checkpoint_every_steps": 4,
+            "online_publish_every_steps": 4,
+            "log_steps": 10_000,
+            "keep_checkpoints": 20,
+        },
+        "elastic": {
+            "enabled": True,
+            "prefer_model_parallel": 4,
+            "drain_commit": drain_commit,
+        },
+    })
+
+
+def _fill_stream(root: str, *, segments: int, rows: int, seed0: int = 0):
+    from deepfm_tpu.online import append_segment
+
+    for seq in range(segments):
+        rng = np.random.default_rng(seed0 + seq)
+        append_segment(
+            root,
+            (rng.random(rows) < 0.3).astype(np.float32),
+            rng.integers(0, FEATURE, (rows, FIELD)).astype(np.int64),
+            rng.random((rows, FIELD)).astype(np.float32),
+            seq=seq,
+        )
+
+
+class _LossRecorder:
+    """MetricLogger stand-in that records per-step loss and runs scripted
+    registry actions at step thresholds (deterministic — no wall-clock
+    races)."""
+
+    def __init__(self, script=None):
+        from deepfm_tpu.utils import MetricLogger
+
+        self._inner = MetricLogger(log_steps=10_000)
+        self._script = sorted((script or {}).items())
+        self._fired = 0
+        self.losses: dict[int, float] = {}
+
+    def seed_step(self, step):
+        self._inner.seed_step(step)
+
+    def event(self, *a, **kw):
+        self._inner.event(*a, **kw)
+
+    def step(self, step, batch_size, metrics, extra=None):
+        self.losses[step] = float(metrics["ce"])
+        self._inner.step(step, batch_size, metrics, extra=extra)
+        if self._fired < len(self._script) \
+                and step >= self._script[self._fired][0]:
+            self._script[self._fired][1]()
+            self._fired += 1
+
+
+def _post(url, payload, timeout=60):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.load(r)
+
+
+def run_drill(
+    root: str,
+    *,
+    segments: int = 8,
+    rows: int = 32,
+    batch: int = 16,
+    shrink_at: int = 5,
+    grow_at: int = 10,
+    drain_commit: bool = True,
+    serve: bool = True,
+) -> dict:
+    """One full drill; returns the metrics document (see module doc)."""
+    import jax
+
+    from deepfm_tpu.serve import export_servable
+    from deepfm_tpu.train.step import create_train_state
+
+    root = os.path.abspath(root)
+    cfg = _cfg(root, batch=batch, drain_commit=drain_commit)
+    _fill_stream(cfg.data.training_data_dir, segments=segments, rows=rows)
+    total_steps = segments * rows // batch
+    devs = jax.devices()
+    if len(devs) < 8:
+        raise RuntimeError(
+            f"the drill needs the 8-device virtual mesh, got {len(devs)} "
+            f"(run under JAX_PLATFORMS=cpu with "
+            f"--xla_force_host_platform_device_count=8)"
+        )
+
+    # -- serving pool: the REAL process topology — the pool CLI spawns the
+    # member as its own process (own XLA runtime: no executor contention
+    # with the trainer's 8-device programs, which would deadlock the
+    # shared XLA:CPU thread pool in-process), router in the supervisor,
+    # one GroupSwapper polling the drill's publish root -------------------
+    serving: dict = {"enabled": bool(serve)}
+    pool_proc = None
+    clients: list[threading.Thread] = []
+    results: list[tuple] = []
+    errors: list[str] = []
+    stop_clients = threading.Event()
+    router_url = None
+    if serve:
+        import socket
+        import subprocess
+
+        base_servable = os.path.join(root, "servable")
+        export_servable(cfg, create_train_state(cfg), base_servable)
+
+        def _free_port():
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+            s.close()
+            return p
+
+        router_port, member_port = _free_port(), _free_port()
+        router_url = f"http://127.0.0.1:{router_port}"
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        pool_proc = subprocess.Popen(
+            [sys.executable, "-m", "deepfm_tpu.serve.pool",
+             "--servable", base_servable, "--router",
+             "--groups", "1", "--group-dp", "1", "--group-mp", "2",
+             "--port", str(router_port),
+             "--member-port-base", str(member_port),
+             "--buckets", "4,8", "--health-interval", "0.2",
+             "--reload-url", cfg.run.servable_model_dir,
+             "--reload-interval", "0.3"],
+            env=env, stderr=subprocess.DEVNULL,
+        )
+
+        def _predict_once(timeout=20):
+            rng = np.random.default_rng(0)
+            return _post(
+                f"{router_url}/v1/models/deepfm:predict",
+                {"instances": [{
+                    "feat_ids": rng.integers(0, FEATURE, FIELD).tolist(),
+                    "feat_vals": rng.random(FIELD).round(4).tolist(),
+                }]},
+                timeout=timeout,
+            )
+
+        # readiness barrier: failures BEFORE the pool ever served are
+        # startup (compile) latency, not serving errors — the drill's
+        # claim is zero failures from ready through the whole shrink/grow
+        deadline = time.time() + 300
+        ready = False
+        while time.time() < deadline:
+            try:
+                _predict_once()
+                ready = True
+                break
+            except Exception:
+                time.sleep(0.5)
+        if not ready:
+            pool_proc.kill()
+            raise RuntimeError("serving pool never became ready")
+        lock = threading.Lock()
+
+        def client(seed):
+            rng = np.random.default_rng(seed)
+            while not stop_clients.is_set():
+                inst = [{
+                    "feat_ids": rng.integers(0, FEATURE, FIELD).tolist(),
+                    "feat_vals": rng.random(FIELD).round(4).tolist(),
+                }]
+                try:
+                    doc = _post(
+                        f"{router_url}/v1/models/deepfm:predict",
+                        {"key": f"k{rng.integers(0, 64)}",
+                         "instances": inst},
+                        timeout=60,
+                    )
+                    with lock:
+                        results.append((doc["group_generation"],
+                                        doc["model_version"]))
+                except Exception as e:
+                    with lock:
+                        errors.append(f"{type(e).__name__}: {e}")
+                time.sleep(0.01)
+
+        clients = [threading.Thread(target=client, args=(100 + i,),
+                                    daemon=True) for i in range(4)]
+        for t in clients:
+            t.start()
+
+    pool_stopped = False
+
+    def _stop_pool():
+        # idempotent teardown, also bound to the outer finally: a failed
+        # training run must never leak the router/member process tree
+        # (and its ports) into the rest of the session
+        nonlocal pool_stopped
+        if pool_proc is None or pool_stopped:
+            return
+        pool_stopped = True
+        stop_clients.set()
+        for t in clients:
+            t.join(timeout=60)
+        pool_proc.terminate()
+        try:
+            pool_proc.wait(timeout=60)
+        except Exception:
+            pool_proc.kill()
+
+    try:
+        return _run_and_measure(
+            cfg, root, devs, serving, results, errors, _stop_pool,
+            segments=segments, rows=rows, batch=batch,
+            shrink_at=shrink_at, grow_at=grow_at,
+            drain_commit=drain_commit, serve=serve,
+            total_steps=total_steps,
+        )
+    finally:
+        _stop_pool()
+
+
+def _run_and_measure(
+    cfg, root, devs, serving, results, errors, stop_pool, *,
+    segments, rows, batch, shrink_at, grow_at, drain_commit, serve,
+    total_steps,
+) -> dict:
+    import jax
+
+    from deepfm_tpu.elastic import ElasticTrainer, VirtualDeviceRegistry
+    from deepfm_tpu.online import list_versions
+
+    # -- the elastic run: shrink [2,4] -> [1,4] mid-stream, grow back ------
+    reg = VirtualDeviceRegistry(devs[:8])
+    trainer = ElasticTrainer(cfg, registry=reg)
+    recorder = _LossRecorder(script={
+        shrink_at: lambda: reg.fail(4, 5, 6, 7),
+        grow_at: lambda: reg.restore(4, 5, 6, 7),
+    })
+    trainer._log = recorder
+    t0 = time.perf_counter()
+    state = trainer.run(follow=False)
+    train_wall = time.perf_counter() - t0
+
+    if serve:
+        # let the swapper ingest the final (post-grow) publish UNDER LOAD,
+        # then stop: the post-shrink versions going live without a single
+        # failed or mixed-version predict is the drill's serving claim
+        want = max(list_versions(cfg.run.servable_model_dir), default=0)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            with_lock = sorted(set(results))
+            if any(v >= want for _, v in with_lock):
+                break
+            time.sleep(0.3)
+        stop_pool()
+        seen = sorted(set(results))
+        # mixed-version detection from the responses alone: a committed
+        # history maps each group generation to exactly ONE version, and
+        # (generation, version) advance together — any generation scored
+        # under two versions, or any version regression as generations
+        # advance, is a mixed state no request may ever observe
+        by_gen: dict[int, set[int]] = {}
+        for g, v in seen:
+            by_gen.setdefault(g, set()).add(v)
+        mixed = [(g, sorted(vs)) for g, vs in sorted(by_gen.items())
+                 if len(vs) > 1]
+        ordered = [max(vs) for _, vs in sorted(by_gen.items())]
+        if ordered != sorted(ordered):
+            mixed.append(("version_regression", ordered))
+        serving.update({
+            "predicts": len(results),
+            "failed": len(errors),
+            "errors_sample": errors[:3],
+            "mixed_version": len(mixed),
+            "mixed_pairs": mixed,
+            "observed_pairs": seen,
+            "final_version": max((v for _, v in seen), default=0),
+            "versions_ingested": len({v for _, v in seen}),
+        })
+
+    # -- the uninterrupted fixed-mesh baseline ------------------------------
+    oroot = os.path.join(root, "baseline")
+    ocfg = _cfg(oroot, batch=batch, drain_commit=drain_commit)
+    _fill_stream(ocfg.data.training_data_dir, segments=segments, rows=rows)
+    oracle_trainer = ElasticTrainer(
+        ocfg, registry=VirtualDeviceRegistry(devs[:8])
+    )
+    oracle_rec = _LossRecorder()
+    oracle_trainer._log = oracle_rec
+    oracle = oracle_trainer.run(follow=False)
+
+    common = sorted(set(recorder.losses) & set(oracle_rec.losses))
+    loss_diffs = [abs(recorder.losses[s] - oracle_rec.losses[s])
+                  for s in common]
+    param_diff = 0.0
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state.params),
+        jax.tree_util.tree_leaves(oracle.params),
+    ):
+        param_diff = max(param_diff, float(np.max(np.abs(
+            np.asarray(jax.device_get(a)) - np.asarray(jax.device_get(b))
+        ))))
+
+    lineage = trainer.cursor_lineage
+    doc = {
+        "drill": {
+            "shrink": [[2, 4], [1, 4]],
+            "grow_back": True,
+            "segments": segments,
+            "rows_per_segment": rows,
+            "batch_size": batch,
+            "total_steps": total_steps,
+            "drain_commit": drain_commit,
+            "train_wall_secs": round(train_wall, 3),
+        },
+        "reshards": trainer.reshards,
+        "reshard_wall_secs": [r["wall_secs"] for r in trainer.reshards],
+        "steps_lost": sum(r["steps_replayed"] for r in trainer.reshards),
+        "exactly_once": {
+            "batches_applied": len(lineage),
+            "expected": total_steps,
+            "lineage_strictly_increasing": all(
+                a < b for a, b in zip(lineage, lineage[1:])
+            ),
+        },
+        "loss_continuity": {
+            "steps_compared": len(common),
+            "max_abs_diff": round(max(loss_diffs), 6) if loss_diffs else None,
+            "final_param_max_abs_diff": round(param_diff, 8),
+            "tolerance": LOSS_TOLERANCE,
+            "pass": bool(loss_diffs) and max(loss_diffs) < LOSS_TOLERANCE,
+        },
+        "serving": serving,
+        "versions_published": len(
+            list_versions(cfg.run.servable_model_dir)
+        ),
+        "final_step": int(state.step),
+    }
+    return doc
+
+
+def main() -> None:
+    import tempfile
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out_path = os.path.join(repo_root, "docs", "BENCH_ELASTIC.json")
+    with tempfile.TemporaryDirectory(prefix="elastic_drill_") as root:
+        doc = run_drill(root)
+    doc["recorded_unix_time"] = int(time.time())
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps({
+        "metric": "elastic_reshard_wall_secs",
+        "value": (max(doc["reshard_wall_secs"])
+                  if doc["reshard_wall_secs"] else None),
+        "steps_lost": doc["steps_lost"],
+        "serving_failed": doc["serving"].get("failed"),
+        "serving_mixed_version": doc["serving"].get("mixed_version"),
+        "loss_continuity_pass": doc["loss_continuity"]["pass"],
+        "artifact": out_path,
+    }))
+    if doc["serving"].get("failed") or doc["serving"].get("mixed_version") \
+            or not doc["loss_continuity"]["pass"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    main()

@@ -62,6 +62,14 @@ not a benchmark:
   operand — only the [B_local, K] candidate packs cross the wire (a
   score-all-then-gather lowering is the seeded regression).
 
+* **elastic-reshard audit** — lower the elastic N→M row-adapt
+  executables (``checkpoint/reshard.jit_row_adapter``) for every audited
+  topology move under ``jax.transfer_guard("disallow")`` and hold the
+  reshard to its contract: table rows re-window device-to-device (no host
+  round-trip on table leaves), the table rides as a lowered PARAMETER,
+  and the planner's traffic stays minimal (a same-width shrink plans
+  zero table bytes; every plan beats the gather-to-host round trip).
+
 * **sharded-predict audit** — lower the shard-group serving pool's
   predict (``serve.pool.sharded.build_sharded_predict_with``) on the
   audited serve meshes and hold it to the pool's contract: lowers under
@@ -1177,6 +1185,141 @@ def audit_funnel(cfg=None, retrieve_builder=None) -> list[Finding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# elastic-reshard contract (elastic/plan.py + checkpoint/reshard.py)
+
+# the N→M transitions the chaos drill exercises: same-width shrink (the
+# spot-reclaim shape), the grow back, and a row-shard width change
+_ELASTIC_AUDIT_MOVES = (
+    ((2, 4), (1, 4)),   # shrink, width stable — plans ZERO table bytes
+    ((1, 4), (2, 4)),   # grow back
+    ((2, 4), (4, 2)),   # width change — windows re-cut, overlap kept
+)
+
+
+def audit_elastic(cfg=None, reshard_builder=None) -> list[Finding]:
+    """The elastic reshard's lowering contract (``elastic/plan.py`` +
+    ``checkpoint/reshard.jit_row_adapter``) on every audited N→M move:
+
+    * **no host round-trip on table leaves** — the row-adapt executable
+      that re-windows a table onto the new mesh lowers under
+      ``transfer_guard('disallow')``: rows move device-to-device through
+      XLA's emitted collective plan, never through a host staging buffer
+      (at north-star vocabularies a host bounce would turn a sub-second
+      reshard into a multi-minute outage);
+    * **table is a lowered PARAMETER** — a baked table constant IS a
+      smuggled host copy, and would pin every reshard to one snapshot;
+    * **plan minimality** — the planner's device-to-device bytes stay
+      strictly under the gather-to-host round trip, and a same-width
+      shrink plans ZERO table traffic (the surviving shards already own
+      their windows).
+
+    ``reshard_builder(sharding, rows_to)`` lets the seeded-violation
+    tests feed a host-round-tripping or baked adapter through the same
+    checks."""
+    import sys
+
+    import jax
+
+    if len(jax.devices()) < 8:
+        print(
+            "trace-audit: elastic-reshard contract SKIPPED — needs >= 8 "
+            "devices (run under JAX_PLATFORMS=cpu with "
+            "--xla_force_host_platform_device_count=8; scripts/check.sh "
+            "and the analysis CLI arrange this)",
+            file=sys.stderr,
+        )
+        return []
+    from ..checkpoint.reshard import jit_row_adapter
+    from ..core.config import MeshConfig
+    from ..elastic.plan import plan_reshard
+    from ..parallel import build_mesh, make_context
+
+    base = cfg or _audit_cfg()
+    where = "deepfm_tpu/elastic/plan.py"
+    builder = reshard_builder or jit_row_adapter
+    out: list[Finding] = []
+    devs = jax.devices()
+    for (dp_a, mp_a), (dp_b, mp_b) in _ELASTIC_AUDIT_MOVES:
+        move = f"{dp_a}x{mp_a}->{dp_b}x{mp_b}"
+        old_ctx = make_context(base, build_mesh(
+            MeshConfig(data_parallel=dp_a, model_parallel=mp_a),
+            devices=devs[: dp_a * mp_a],
+        ))
+        new_ctx = make_context(base, build_mesh(
+            MeshConfig(data_parallel=dp_b, model_parallel=mp_b),
+            devices=devs[: dp_b * mp_b],
+        ))
+        plan = plan_reshard(old_ctx, new_ctx)
+        if plan.host_round_trip or plan.moved_bytes >= plan.naive_bytes:
+            out.append(_finding(
+                "trace-collective",
+                f"elastic reshard plan {move} is not minimal-traffic: "
+                f"moved {plan.moved_bytes} bytes vs gather-to-host "
+                f"{plan.naive_bytes} (host_round_trip="
+                f"{plan.host_round_trip})",
+                hint="the planner must move only new_window - held_rows "
+                     "per device (elastic/plan.plan_reshard)",
+                where=where, slug=f"elastic-{move}-plan-not-minimal",
+            ))
+        if mp_a == mp_b and dp_b < dp_a and plan.moved_bytes != 0:
+            out.append(_finding(
+                "trace-collective",
+                f"same-width shrink {move} plans {plan.moved_bytes} table "
+                f"bytes — the surviving shards already own their row "
+                f"windows; a correct plan moves ZERO",
+                where=where, slug=f"elastic-{move}-shrink-moves-bytes",
+            ))
+        pv_old = old_ctx.cfg.model.feature_size
+        pv_new = new_ctx.cfg.model.feature_size
+        k = base.model.embedding_size
+        for leaf, shape in (("fm_v", (pv_old, k)), ("fm_w", (pv_old,))):
+            # the real restore path: the saved-shape leaf lands on the NEW
+            # mesh (Orbax streams each device's chunks from disk; the live
+            # path stages with device_put), then the row adapt runs
+            # entirely on the new topology — one executable cannot span
+            # two device sets
+            new_sh = new_ctx.state_shardings.params[leaf]
+            fn = builder(new_sh, pv_new)
+            abstract = jax.ShapeDtypeStruct(
+                shape, jax.numpy.float32, sharding=new_sh
+            )
+            try:
+                with jax.transfer_guard("disallow"):
+                    try:
+                        lowered = fn.lower(abstract)
+                    except TypeError:
+                        # an adapter that dropped the table argument
+                        # (baked snapshot) still lowers; the leaf-count
+                        # contract below convicts it
+                        lowered = fn.lower()
+            except Exception as e:
+                out.append(_finding(
+                    "trace-transfer",
+                    f"elastic reshard {move} of {leaf} under "
+                    f"transfer_guard('disallow') raised "
+                    f"{type(e).__name__}: {e} — the row adapt performs a "
+                    f"host round-trip on a table leaf",
+                    hint="rows must re-window on-device "
+                         "(checkpoint/reshard.jit_row_adapter)",
+                    where=where, slug=f"elastic-{move}-{leaf}-host-trip",
+                ))
+                continue
+            n_in = len(jax.tree_util.tree_leaves(lowered.in_avals))
+            if n_in != 1:
+                out.append(_finding(
+                    "trace-transfer",
+                    f"elastic reshard {move} of {leaf} lowered with "
+                    f"{n_in} input leaves, expected the table as the ONE "
+                    f"parameter — a baked table constant is a smuggled "
+                    f"host staging copy",
+                    hint="the adapter must take the table as its "
+                         "argument (checkpoint/reshard.jit_row_adapter)",
+                    where=where, slug=f"elastic-{move}-{leaf}-baked",
+                ))
+    return out
+
+
 def run_trace_audit(cfg=None) -> list[Finding]:
     """All engine-2 audits against the real entrypoints (abstract values
     only; no step executes).  Importing jax is the price of admission —
@@ -1189,4 +1332,5 @@ def run_trace_audit(cfg=None) -> list[Finding]:
     findings.extend(audit_spmd_exchange(cfg))
     findings.extend(audit_sharded_predict(cfg))
     findings.extend(audit_funnel(cfg))
+    findings.extend(audit_elastic(cfg))
     return findings

@@ -1,6 +1,9 @@
 from .ckpt import Checkpointer, maybe_clear  # noqa: F401
 from .remote import RemoteCheckpointer, make_checkpointer  # noqa: F401
-from .reshard import restore_resharded  # noqa: F401
+from .reshard import (  # noqa: F401
+    restore_resharded,
+    restore_resharded_payload,
+)
 
 
 def save_paged(trainer, directory: str) -> dict:

@@ -91,14 +91,46 @@ def _reshape_under_sharding_ok(sharding) -> bool:
     _RESHAPE_PROBE_CACHE[key] = ok
     return ok
 
-# mirror parallel/spmd.TABLE_KEYS without importing (keeps this module free
-# of the parallel -> models import chain at import time)
-_TABLE_KEYS = ("fm_w", "fm_v", "embedding", "user_embedding", "item_embedding")
+
+
+class ReshardDataLossError(ValueError):
+    """Deliberate refusal: the target vocabulary is smaller than the
+    checkpoint's true data.  Semantic — NOT a torn checkpoint, so the
+    latest-step fallback must propagate it instead of silently restoring
+    an older payload (which would hold the same data and refuse again,
+    or worse, mask the misconfiguration)."""
+
+
+def jit_row_adapter(sharding, rows_to: int):
+    """The device-to-device row reshape at the heart of every reshard:
+    slice dim0 down to ``rows_to`` or zero-pad it up, with the OUTPUT
+    committed to ``sharding`` — XLA emits the collective plan (all-gather /
+    dynamic-slice of owned rows across the target mesh) and no row ever
+    stages on the host.  Shared by the cross-topology restore below, the
+    elastic live reshard (``deepfm_tpu/elastic/plan.py``), and the
+    ``audit_elastic`` trace contract, which lowers exactly this executable
+    under ``transfer_guard('disallow')`` to prove the no-host-round-trip
+    claim."""
+
+    def _reshape_rows(a):
+        if a.shape[0] >= rows_to:
+            return a[:rows_to]
+        pad = rows_to - a.shape[0]
+        return jnp.concatenate(
+            [a, jnp.zeros((pad, *a.shape[1:]), a.dtype)]
+        )
+
+    return jax.jit(_reshape_rows, out_shardings=sharding)
 
 
 def _is_table_leaf(path) -> bool:
+    # the authoritative row-sharded-table key list, read at CALL time (a
+    # module-level import would drag the parallel -> models chain into
+    # this module's import; a copy would silently miss new tables)
+    from ..parallel.spmd import TABLE_KEYS
+
     keys = {getattr(p, "key", None) for p in path}
-    return bool(keys & set(_TABLE_KEYS))
+    return bool(keys & set(TABLE_KEYS))
 
 
 def _dictify(x):
@@ -136,28 +168,123 @@ def restore_resharded(
     ckpt: Checkpointer,
     ctx,
     step: int | None = None,
+    *,
+    plan=None,
 ) -> TrainState:
     """Restore ``ckpt``'s latest (or ``step``) checkpoint into ``ctx``'s
     mesh/shardings, adapting table row padding between topologies.
+
+    ``plan`` (an :class:`~deepfm_tpu.elastic.plan.ReshardPlan`) is the
+    elastic controller's pre-computed N→M redistribution: when given, the
+    target topology is validated against it BEFORE any bytes move (a plan
+    drawn for a different mesh or padding fails loudly instead of
+    restoring into the wrong shardings).
 
     Raises if a slice would drop non-zero rows (i.e. the target vocabulary
     is genuinely smaller than the data in the checkpoint).
     """
     from ..parallel.spmd import _build_full_init
 
-    mngr = ckpt._mngr
-    mngr.wait_until_finished()
-    step = mngr.latest_step() if step is None else step
-    if step is None:
-        raise FileNotFoundError("no checkpoint to restore")
-
+    if plan is not None:
+        plan.validate_target(ctx)
     # target template (shape inference only — nothing materializes)
     init_fn = _build_full_init(ctx.cfg, ctx.true_feature_size)
     target_shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    return _restore_resharded_tree(
+        ckpt, target_shapes, ctx.state_shardings, step
+    )
+
+
+def restore_resharded_payload(
+    ckpt: Checkpointer,
+    ctx,
+    step: int | None = None,
+    *,
+    plan=None,
+):
+    """Cross-topology restore of an :class:`~deepfm_tpu.online.trainer.
+    OnlinePayload` — the elastic trainer's resume point: {weights,
+    optimizer state, stream cursor} adapt to the new mesh as ONE atomic
+    tree, so the cursor can never resume against weights from a different
+    commit (the exactly-once invariant survives the topology change).
+    Table leaves inside ``payload.train`` reshard exactly as in
+    :func:`restore_resharded`; the cursor arrays restore replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..online.trainer import _CURSOR_BYTES, OnlinePayload
+    from ..parallel.spmd import _build_full_init
+
+    if plan is not None:
+        plan.validate_target(ctx)
+    init_fn = _build_full_init(ctx.cfg, ctx.true_feature_size)
+    train_shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    target_shapes = OnlinePayload(
+        step=jax.ShapeDtypeStruct((), jnp.int32),
+        train=train_shapes,
+        cursor_segment=jax.ShapeDtypeStruct((_CURSOR_BYTES,), jnp.uint8),
+        cursor_len=jax.ShapeDtypeStruct((), jnp.int32),
+        cursor_record=jax.ShapeDtypeStruct((), jnp.int64),
+    )
+    repl = NamedSharding(ctx.mesh, P())
+    shardings = OnlinePayload(
+        step=repl,
+        train=ctx.state_shardings,
+        cursor_segment=repl,
+        cursor_len=repl,
+        cursor_record=repl,
+    )
+    return _restore_resharded_tree(ckpt, target_shapes, shardings, step)
+
+
+def _restore_resharded_tree(
+    ckpt: Checkpointer, target_shapes, target_shardings, step: int | None
+):
+    """The shared cross-topology restore engine: stream every leaf from
+    the checkpoint directly INTO a sharding on the target mesh, adapting
+    table-leaf row counts on-device (``jit_row_adapter``).
+
+    When no step is pinned, unreadable (torn) steps fall back to the
+    previous complete one — the same discipline as
+    ``online.trainer.restore_latest_payload``: a reshard triggered right
+    after a commit was torn mid-write must resume from the previous
+    payload, not die on the step it was hardened against."""
+    import logging
+
+    mngr = ckpt._mngr
+    mngr.wait_until_finished()
+    if step is not None:
+        return _restore_tree_at(ckpt, target_shapes, target_shardings, step)
+    steps = sorted(mngr.all_steps(), reverse=True)
+    if not steps:
+        raise FileNotFoundError("no checkpoint to restore")
+    last_err: Exception | None = None
+    for s in steps:
+        try:
+            return _restore_tree_at(
+                ckpt, target_shapes, target_shardings, s
+            )
+        except ReshardDataLossError:
+            raise  # deliberate refusal, not a torn step
+        except Exception as e:
+            last_err = e
+            logging.getLogger(__name__).warning(
+                "checkpoint step %d unreadable for resharded restore "
+                "(%s: %s) — falling back to the previous complete step",
+                s, type(e).__name__, e)
+    raise RuntimeError(
+        f"every checkpoint step {steps} is unreadable; last error: "
+        f"{type(last_err).__name__}: {last_err}"
+    ) from last_err
+
+
+def _restore_tree_at(
+    ckpt: Checkpointer, target_shapes, target_shardings, step: int
+):
+    mngr = ckpt._mngr
     # Orbax stores the state in dict form (NamedTuples -> field dicts,
-    # tuples -> lists); adapt in that form, then rebuild the TrainState
+    # tuples -> lists); adapt in that form, then rebuild the pytree
     target_dict = _dictify(target_shapes)
-    shard_dict = _dictify(ctx.state_shardings)
+    shard_dict = _dictify(target_shardings)
 
     # saved template from checkpoint metadata (same dict-form structure).
     # Every leaf restores INTO a sharding over the target mesh: exact-shape
@@ -167,6 +294,17 @@ def restore_resharded(
     import orbax.checkpoint as ocp
 
     meta = mngr.item_metadata(step)
+    if not jax.tree_util.tree_leaves(meta):
+        # a FRESH manager (restart path) has no handler registered yet and
+        # returns an empty placeholder instead of the saved tree structure;
+        # read the metadata through a throwaway manager with the standard
+        # handler pre-registered (managers that already saved or restored
+        # in-process take the fast path above)
+        with ocp.CheckpointManager(
+            mngr.directory,
+            item_handlers=ocp.StandardCheckpointHandler(),
+        ) as meta_mngr:
+            meta = meta_mngr.item_metadata(step)
     # meta's treedef is an Orbax wrapper type that cannot be tree-mapped
     # together with the plain dict-form target trees — but its LEAF order is
     # congruent with them (same logical structure, same sorted-dict
@@ -236,27 +374,20 @@ def restore_resharded(
                 jax.jit(lambda a: jnp.any(a[rows_t:] != 0))(saved)
             )
             if dropped_nonzero:
-                raise ValueError(
+                raise ReshardDataLossError(
                     f"resharding {jax.tree_util.keystr(path)} from "
                     f"{rows_s} to {rows_t} rows would drop non-zero "
                     f"data — the target feature_size is smaller than the "
                     f"checkpoint's true vocabulary"
                 )
             if _reshape_under_sharding_ok(sharding):
-                return jax.jit(
-                    lambda a: a[:rows_t], out_shardings=sharding
-                )(saved)
+                return jit_row_adapter(sharding, rows_t)(saved)
             return jax.device_put(
                 np.asarray(jax.device_get(saved))[:rows_t], sharding
             )
-        pad = rows_t - rows_s
         if _reshape_under_sharding_ok(sharding):
-            return jax.jit(
-                lambda a: jnp.concatenate(
-                    [a, jnp.zeros((pad, *a.shape[1:]), a.dtype)]
-                ),
-                out_shardings=sharding,
-            )(saved)
+            return jit_row_adapter(sharding, rows_t)(saved)
+        pad = rows_t - rows_s
         host = np.asarray(jax.device_get(saved))
         host = np.concatenate(
             [host, np.zeros((pad, *host.shape[1:]), host.dtype)]
@@ -270,5 +401,5 @@ def restore_resharded(
 
     # no-op for leaves already in their final sharding; places stragglers
     return jax.tree_util.tree_map(
-        jax.device_put, state, ctx.state_shardings
+        jax.device_put, state, target_shardings
     )

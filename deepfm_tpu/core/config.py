@@ -290,6 +290,52 @@ class MeshConfig:
 
 
 @dataclass(frozen=True)
+class ElasticConfig:
+    """Elastic preemption-tolerant training (``deepfm_tpu/elastic``): mesh
+    shape as a RUNTIME variable.  A device registry watches availability;
+    on a shrink/grow the trainer drains the in-flight step, commits
+    {weights, optimizer state, stream cursor} as one Orbax payload, plans
+    a minimal-traffic N→M redistribution, rebuilds mesh/shardings/compiled
+    steps for the new topology, and resumes the stream cursor exactly-once
+    (online/trainer.py commit semantics).  Publishing continues across the
+    reshard, so serving never observes the topology change."""
+
+    # run the elastic controller instead of the fixed-mesh online trainer
+    # (task_type=online-train only; batch training keeps the stop-the-world
+    # restart path in launch/preemption.py + checkpoint/reshard.py)
+    enabled: bool = False
+    # preferred embedding row-shard width: the planner picks the LARGEST
+    # divisor of the live device count <= this (0 = mesh.model_parallel).
+    # Keeping mp stable across a shrink keeps the padded vocab — and so the
+    # published artifact shapes — identical, which keeps every post-reshard
+    # group swap at the serving pool a jit cache hit.
+    prefer_model_parallel: int = 0
+    # refuse to rebuild on fewer devices than this; wait for capacity
+    min_devices: int = 1
+    # registry poll cadence while waiting for capacity to return
+    poll_interval_secs: float = 0.25
+    # max seconds to wait for min_devices after a shrink below it
+    # (0 = wait forever — the platform owns the reschedule)
+    wait_for_capacity_secs: float = 0.0
+    # attempt a drain+commit on the OLD mesh before resharding (virtual
+    # registries and advance-notice preemptions); when the commit itself
+    # fails (devices already gone) the last periodic commit is the resume
+    # point — exactly-once either way, the failed window just replays
+    drain_commit: bool = True
+
+    def __post_init__(self):
+        if self.min_devices < 1:
+            raise ValueError(
+                f"elastic.min_devices must be >= 1, got {self.min_devices}"
+            )
+        if self.prefer_model_parallel < 0:
+            raise ValueError(
+                f"elastic.prefer_model_parallel must be >= 0 (0 = "
+                f"mesh.model_parallel), got {self.prefer_model_parallel}"
+            )
+
+
+@dataclass(frozen=True)
 class RunConfig:
     """Run/driver config: task dispatch + paths (ps:70-79) + cluster identity
     (SM_HOSTS/SM_CURRENT_HOST analogs, ps:80-95)."""
@@ -402,6 +448,7 @@ class Config:
     data: DataConfig = field(default_factory=DataConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     run: RunConfig = field(default_factory=RunConfig)
+    elastic: ElasticConfig = field(default_factory=ElasticConfig)
 
     def __post_init__(self):
         """Cross-section contracts no single section can check.
@@ -588,6 +635,9 @@ class Config:
             data=DataConfig(**known(DataConfig, d.get("data", {}), "data")),
             mesh=MeshConfig(**known(MeshConfig, d.get("mesh", {}), "mesh")),
             run=RunConfig(**known(RunConfig, d.get("run", {}), "run")),
+            elastic=ElasticConfig(
+                **known(ElasticConfig, d.get("elastic", {}), "elastic")
+            ),
         )
 
     @classmethod

@@ -1,0 +1,22 @@
+"""Elastic preemption-tolerant training: live N→M mesh resharding with
+exactly-once resume and uninterrupted serving.
+
+* ``registry``   — device availability (virtual for tests/chaos drills,
+  ``jax.devices()`` liveness in production)
+* ``plan``       — mesh choice policy + minimal-traffic redistribution
+  planning (no gather-to-host; arxiv 2112.01075's frame)
+* ``controller`` — the ElasticTrainer lifecycle: detect → drain →
+  commit → replan → reshard → resume → publish
+"""
+
+from .controller import ElasticTrainer, run_elastic_train  # noqa: F401
+from .plan import (  # noqa: F401
+    ReshardPlan,
+    choose_mesh,
+    plan_reshard,
+    reshard_state,
+)
+from .registry import (  # noqa: F401
+    LiveDeviceRegistry,
+    VirtualDeviceRegistry,
+)

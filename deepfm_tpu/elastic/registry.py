@@ -1,0 +1,153 @@
+"""Device availability: the signal that makes mesh shape a runtime variable.
+
+Production TPU pods lose and regain slices mid-run (maintenance events,
+spot reclaims); the reference's async-PS answer was workers that merely
+tolerate stragglers.  A synchronous SPMD program instead needs an explicit
+availability signal it can *act* on: drain, reshard, resume (the elastic
+controller, ``elastic/controller.py``).
+
+Two registries behind one tiny protocol — ``devices()`` (the live device
+list, stable order) and ``epoch`` (bumped on every membership change, the
+cheap "did anything move?" poll the train loop makes once per step):
+
+* :class:`VirtualDeviceRegistry` — a scriptable registry over a fixed
+  device list (the 8-device virtual CPU mesh in CI): ``fail(...)`` /
+  ``restore(...)`` simulate a slice loss / regain deterministically.  The
+  chaos drills kill and revive devices mid-run through exactly this seam.
+* :class:`LiveDeviceRegistry` — polls ``jax.devices()`` liveness in
+  production.  The JAX runtime surfaces a lost slice as a changed (or
+  erroring) device list after the distributed runtime reinitializes; the
+  registry reduces that to the same epoch/devices protocol, so the
+  controller code is identical under test and on hardware.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Sequence
+
+
+class VirtualDeviceRegistry:
+    """Deterministic, scriptable device availability over a fixed list.
+
+    ``fail``/``restore`` take device *indices into the base list* (stable
+    across calls — a restored device returns to its original position, so
+    a shrink-then-grow round trip rebuilds the identical mesh layout).
+    Thread-safe: chaos drills flip availability from a scripting thread
+    while the trainer polls from the step loop.
+    """
+
+    def __init__(self, devices: Sequence | None = None):
+        if devices is None:
+            import jax
+
+            devices = jax.devices()
+        self._base = tuple(devices)
+        if not self._base:
+            raise ValueError("registry needs at least one device")
+        self._failed: set[int] = set()
+        self._lock = threading.Lock()
+        self._epoch = 0
+
+    @property
+    def epoch(self) -> int:
+        """Monotone membership-change counter — equality with a cached
+        value means the device set is unchanged since the cache."""
+        with self._lock:
+            return self._epoch
+
+    def devices(self) -> tuple:
+        """Live devices in base-list order."""
+        with self._lock:
+            return tuple(
+                d for i, d in enumerate(self._base) if i not in self._failed
+            )
+
+    def fail(self, *indices: int) -> int:
+        """Mark devices (by base-list index) unavailable; returns the new
+        epoch.  Failing an already-failed device is a no-op (no epoch
+        bump — spurious duplicate events must not trigger a reshard)."""
+        with self._lock:
+            before = set(self._failed)
+            for i in indices:
+                if not 0 <= i < len(self._base):
+                    raise IndexError(
+                        f"device index {i} out of range "
+                        f"[0, {len(self._base)})"
+                    )
+                self._failed.add(i)
+            if self._failed != before:
+                self._epoch += 1
+            return self._epoch
+
+    def restore(self, *indices: int) -> int:
+        """Return devices to availability; no-op (no epoch bump) for
+        devices that were never failed."""
+        with self._lock:
+            before = set(self._failed)
+            for i in indices:
+                self._failed.discard(i)
+            if self._failed != before:
+                self._epoch += 1
+            return self._epoch
+
+    def snapshot(self) -> tuple[int, tuple]:
+        """Atomic (epoch, devices) pair: the controller caches the epoch
+        of the snapshot it BUILT a mesh from, so a membership flip between
+        reading the epoch and reading the device list can never pair a new
+        epoch with a stale device set."""
+        with self._lock:
+            return self._epoch, tuple(
+                d for i, d in enumerate(self._base) if i not in self._failed
+            )
+
+
+class LiveDeviceRegistry:
+    """Production registry: ``jax.devices()`` liveness, reduced to the
+    epoch/devices protocol.
+
+    Each ``poll()`` re-reads the backend device list; a change (different
+    ids, or the query itself failing — a collapsed slice can make the
+    runtime raise until reinitialized) bumps the epoch.  ``devices()``
+    returns the last successful read, so the controller can still drain
+    and commit on surviving state while the runtime churns.
+    """
+
+    def __init__(self):
+        import jax
+
+        self._jax = jax
+        self._lock = threading.Lock()
+        self._epoch = 0
+        self._last = tuple(jax.devices())
+        self._last_ids = tuple(d.id for d in self._last)
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def devices(self) -> tuple:
+        with self._lock:
+            return self._last
+
+    def poll(self) -> int:
+        """Re-read backend liveness; bump the epoch on any change."""
+        try:
+            live = tuple(self._jax.devices())
+            ids = tuple(d.id for d in live)
+        # da:allow[swallowed-exception] a collapsed slice makes the device query raise; that IS the signal
+        except Exception:
+            live, ids = (), ()
+        with self._lock:
+            if ids != self._last_ids:
+                self._epoch += 1
+                if live:  # keep the last good list while the runtime churns
+                    self._last = live
+                self._last_ids = ids
+            return self._epoch
+
+    def snapshot(self) -> tuple[int, tuple]:
+        self.poll()
+        with self._lock:
+            return self._epoch, self._last

@@ -39,7 +39,7 @@ def apply_set_overrides(cfg: Config, pairs: list[str]) -> Config:
         if "=" not in pair or "." not in pair.split("=", 1)[0]:
             raise SystemExit(
                 f"--set expects section.key=value, got {pair!r} "
-                f"(sections: model, optimizer, data, mesh, run)"
+                f"(sections: model, optimizer, data, mesh, run, elastic)"
             )
         key, value = pair.split("=", 1)
         section, field = key.split(".", 1)

@@ -22,6 +22,7 @@ Two pieces, composable:
 
 from __future__ import annotations
 
+import itertools
 import signal
 import threading
 import time
@@ -50,14 +51,22 @@ def install_early_handler(signals=_DEFAULT_SIGNALS) -> bool:
     infer keep default signal semantics so SIGTERM still stops them).
     A REPEATED signal escalates to default handling (immediate termination)
     so a wedged setup can still be killed with a second Ctrl-C.
-    No-op off the main thread.  Returns True when installed."""
+    No-op off the main thread.  Returns True when installed.
+
+    Re-entrancy: the arrival counter (see PreemptionGuard._handle) makes
+    a second signal landing INSIDE the first invocation escalate
+    deterministically — a check-then-set flag would let both invocations
+    read "first" and swallow the escalation."""
     if threading.current_thread() is not threading.main_thread():
         return False
 
+    arrivals = itertools.count()
+
     def _record(signum, frame) -> None:
-        if _EARLY_SIGNAL.is_set():
-            _escalate(signum)
+        n = next(arrivals)  # atomic under the GIL (one bytecode)
         _EARLY_SIGNAL.set()
+        if n > 0:
+            _escalate(signum)
 
     for sig in signals:
         signal.signal(sig, _record)
@@ -85,6 +94,10 @@ class PreemptionGuard:
     def __init__(self, signals=_DEFAULT_SIGNALS):
         self._signals = tuple(signals)
         self._stop = threading.Event()
+        # stop-request arrival counter: next() is ONE bytecode, so it is
+        # atomic w.r.t. signal-handler re-entrancy (handlers run between
+        # bytecodes on the main thread and can interrupt each other)
+        self._arrivals = itertools.count()
         self._prev: dict[int, object] = {}
         self._installed = False
         self.signaled_at: float | None = None
@@ -124,16 +137,30 @@ class PreemptionGuard:
     # -- flag --------------------------------------------------------------
 
     def _handle(self, signum, frame) -> None:
-        if self._stop.is_set():
+        # claim an arrival slot FIRST, atomically.  The previous
+        # check-then-set shape (`if self._stop.is_set(): _escalate(...)`)
+        # raced its own re-entrancy: a second SIGTERM delivered INSIDE
+        # _handle — after the is_set() check, before the set() — saw the
+        # flag still clear, so BOTH invocations took the "first signal"
+        # path and the escalation was silently lost (the process could no
+        # longer be terminated without SIGKILL).  With the counter, exactly
+        # one invocation draws slot 0 regardless of interleaving; every
+        # other one escalates deterministically.
+        n = next(self._arrivals)
+        self.signaled_at = time.time()
+        self._stop.set()
+        if n > 0:
             # repeated signal while a graceful stop is already pending
             # (e.g. Ctrl-C during a long compile): escalate to default
             # handling so the process can actually be terminated
             _escalate(signum)
-        self.signaled_at = time.time()
-        self._stop.set()
 
     def request_stop(self) -> None:
-        """Set the flag without a signal (tests, cooperative shutdown)."""
+        """Set the flag without a signal (tests, cooperative shutdown).
+        Draws an arrival slot like a real signal, so a SIGTERM landing
+        after a cooperative stop still escalates (the pre-fix behavior,
+        preserved)."""
+        next(self._arrivals)
         self.signaled_at = time.time()
         self._stop.set()
 

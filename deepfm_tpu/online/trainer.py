@@ -95,6 +95,58 @@ class OnlinePayload(NamedTuple):
         )
 
 
+def commit_payload(ckpt, state: TrainState, cursor: StreamCursor) -> None:
+    """Atomically persist {weights, optimizer state, cursor} — the
+    exactly-once boundary, shared by the fixed-mesh and elastic trainers.
+
+    Hardened against preemption mid-write: the save blocks until the
+    payload is durable, then VERIFIES the step is in the manager's
+    committed set.  Orbax writes into a tmp-suffixed directory and renames
+    it into place only on completion, so a kill mid-write leaves a torn
+    tree that is *invisible* (not listed, never restored) rather than
+    corrupt — the manifest-last discipline of the publisher, applied to
+    checkpoints.  The post-save membership check turns the remaining
+    failure mode — a save that silently never landed (full disk swallowed
+    by an async layer) — into a loud error at the commit site instead of
+    a missing resume point at the next restart."""
+    step = int(state.step)
+    ckpt.save(OnlinePayload.wrap(state, cursor), block=True)
+    if step not in ckpt.all_steps():
+        raise RuntimeError(
+            f"commit at step {step} did not become durable (committed "
+            f"steps: {ckpt.all_steps()}) — refusing to consume past an "
+            f"unpersisted cursor"
+        )
+
+
+def restore_latest_payload(ckpt, template: "OnlinePayload") -> "OnlinePayload":
+    """Restore the newest COMPLETE payload, falling back across torn
+    steps.  A checkpoint killed mid-write is normally invisible (tmp
+    directory, never renamed); this guards the residual window — a
+    renamed-but-unreadable step (partial object-store upload listed by a
+    stale index, bit rot) — by stepping back to the previous complete
+    payload instead of dying.  Skipped steps are logged loudly: they mean
+    real durability loss happened upstream."""
+    import logging
+
+    steps = sorted(ckpt.all_steps(), reverse=True)
+    if not steps:
+        raise FileNotFoundError("no checkpoint to restore")
+    last_err: Exception | None = None
+    for s in steps:
+        try:
+            return ckpt.restore(template, step=s)
+        except Exception as e:
+            last_err = e
+            logging.getLogger(__name__).warning(
+                "checkpoint step %d unreadable (%s: %s) — falling back to "
+                "the previous complete payload", s, type(e).__name__, e)
+    raise RuntimeError(
+        f"every checkpoint step {steps} is unreadable; last error: "
+        f"{type(last_err).__name__}: {last_err}"
+    ) from last_err
+
+
 class OnlineTrainer:
     """Drive the standard train step over a tailed event log.
 
@@ -144,8 +196,9 @@ class OnlineTrainer:
     def _commit(self, ckpt, state: TrainState, cursor: StreamCursor) -> None:
         """Atomically persist {weights, optimizer state, cursor}.  Blocking:
         the commit IS the exactly-once boundary — publish and further
-        consumption must not outrun it."""
-        ckpt.save(OnlinePayload.wrap(state, cursor), block=True)
+        consumption must not outrun it.  Durability-verified and
+        torn-write-safe: see :func:`commit_payload`."""
+        commit_payload(ckpt, state, cursor)
 
     def _publish(self, state: TrainState, cursor: StreamCursor) -> None:
         manifest = self.publisher.publish(
@@ -190,7 +243,12 @@ class OnlineTrainer:
         state = create_train_state(cfg)
         cursor = StreamCursor()
         if ckpt.latest_step() is not None:
-            restored = ckpt.restore(OnlinePayload.wrap(state, cursor))
+            # torn-checkpoint fallback: a step killed mid-write restores
+            # the PREVIOUS complete payload (weights + cursor roll back
+            # together — the replayed tail applies exactly once)
+            restored = restore_latest_payload(
+                ckpt, OnlinePayload.wrap(state, cursor)
+            )
             state = restored.train
             cursor = restored.cursor()
             self._log.event(
@@ -294,8 +352,10 @@ def replay_to_state(cfg: Config, *, max_batches: int = 0) -> TrainState:
 __all__ = [
     "OnlinePayload",
     "OnlineTrainer",
+    "commit_payload",
     "cursor_from_arrays",
     "cursor_to_arrays",
     "replay_to_state",
+    "restore_latest_payload",
     "run_online_train",
 ]

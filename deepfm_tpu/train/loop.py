@@ -722,7 +722,14 @@ def run_task(cfg: Config):
     if task in ("online-train", "online_train"):
         # continuous training from the event log at training_data_dir,
         # publishing versioned servables the serve task hot-reloads
-        # (online/trainer.py; the online half of the train->serve loop)
+        # (online/trainer.py; the online half of the train->serve loop).
+        # With elastic.enabled the mesh shape becomes a runtime variable:
+        # the controller reshards live on device loss/regain instead of
+        # dying with the mesh (deepfm_tpu/elastic)
+        if cfg.elastic.enabled:
+            from ..elastic import run_elastic_train
+
+            return run_elastic_train(cfg)
         from ..online.trainer import run_online_train
 
         return run_online_train(cfg)

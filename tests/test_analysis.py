@@ -1344,3 +1344,62 @@ class TestFunnelContract:
         findings = audit_funnel(retrieve_builder=baked_builder)
         assert any(f.rule == "trace-recompile"
                    and "baked" in f.message for f in findings), findings
+
+
+class TestElasticReshardContract:
+    """The elastic reshard's trace contract (trace_audit.audit_elastic,
+    wired into scripts/check.sh via run_trace_audit): no host round-trip
+    on table leaves, the table as a lowered parameter, minimal-traffic
+    planning on every audited N→M move."""
+
+    def test_real_reshard_holds_the_contract(self):
+        from deepfm_tpu.analysis.trace_audit import audit_elastic
+
+        findings = audit_elastic()
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_seeded_host_round_trip_caught(self):
+        """An adapter that concretizes the traced table (a device->host
+        transfer in the middle of the reshard) must be convicted by the
+        transfer contract on every move."""
+        import jax
+        import jax.numpy as jnp
+
+        from deepfm_tpu.analysis.trace_audit import audit_elastic
+
+        def smuggling_builder(sharding, rows_to):
+            def adapt(a):
+                # the sneak: host-reads the traced rows mid-reshard
+                if float(jnp.sum(a)) >= 0:
+                    pass
+                return a[:rows_to]
+
+            return jax.jit(adapt, out_shardings=sharding)
+
+        findings = audit_elastic(reshard_builder=smuggling_builder)
+        assert any(f.rule == "trace-transfer"
+                   and "host round-trip" in f.message
+                   for f in findings), findings
+
+    def test_seeded_baked_table_caught(self):
+        """An adapter that drops the table argument and bakes a concrete
+        snapshot into the executable is a smuggled host staging copy —
+        convicted by the leaf-count contract."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from deepfm_tpu.analysis.trace_audit import audit_elastic
+
+        def baked_builder(sharding, rows_to):
+            width = 32  # the audit cfg's embedding size
+            const = np.zeros((rows_to, width), np.float32)
+
+            def adapt():
+                return jnp.asarray(const)
+
+            return jax.jit(adapt, out_shardings=sharding)
+
+        findings = audit_elastic(reshard_builder=baked_builder)
+        assert any(f.rule == "trace-transfer"
+                   and "baked" in f.message for f in findings), findings

@@ -336,6 +336,109 @@ def test_crash_between_cursor_commit_and_publish_resumes_exactly_once(tmp_path):
     assert manifest.cursor == {"segment": segment_name(5), "record": 8}
 
 
+def test_kill_during_commit_is_unreadable_not_corrupt(tmp_path):
+    """A SIGKILL mid-Orbax-write leaves a tmp-suffixed directory that the
+    manager never lists — the checkpoint analog of the publisher's
+    manifest-last ordering: a torn step is INVISIBLE, never half-read.
+    Verified at the layout level: a tmp-named step dir full of garbage
+    does not become latest and does not perturb restore."""
+    import jax.numpy as jnp
+
+    from deepfm_tpu.checkpoint import Checkpointer
+    from deepfm_tpu.train.step import create_train_state
+
+    cfg = _cfg(str(tmp_path))
+    state = create_train_state(cfg)
+    payload = OnlinePayload.wrap(state, StreamCursor(segment_name(0), 8))
+    ck = Checkpointer(tmp_path / "ck")
+    ck.save(payload, block=True)
+    ck.close()
+    # the kill window: Orbax stages into "<step>.orbax-checkpoint-tmp-*"
+    # and renames into place only on completion — fabricate the corpse a
+    # mid-write kill leaves behind
+    torn = tmp_path / "ck" / "5.orbax-checkpoint-tmp-1234567"
+    torn.mkdir(parents=True)
+    (torn / "garbage").write_bytes(b"\x00" * 64)
+    ck2 = Checkpointer(tmp_path / "ck")
+    assert ck2.latest_step() == 0  # the torn step 5 is invisible
+    template = OnlinePayload.wrap(create_train_state(cfg), StreamCursor())
+    restored = ck2.restore(template)
+    assert restored.cursor() == StreamCursor(segment_name(0), 8)
+    assert bool(jnp.all(restored.train.params["fm_v"]
+                        == state.params["fm_v"]))
+    ck2.close()
+
+
+def test_kill_during_commit_resumes_previous_complete_payload(tmp_path):
+    """Chaos drill for the residual torn-write window: a step directory
+    that got RENAMED into place but is unreadable (partial object-store
+    upload listed by a stale index, bit rot).  The restarted trainer must
+    fall back to the previous COMPLETE payload — weights and cursor
+    together — and the resumed run must match the uninterrupted oracle
+    bit-for-bit (the replayed tail applies exactly once)."""
+    import shutil
+
+    cfg = _cfg(str(tmp_path), checkpoint_every_steps=2,
+               online_publish_every_steps=0)
+    _fill_stream(cfg.data.training_data_dir, segments=6, rows=8)
+
+    # phase 1: consume 4 batches -> complete commits at steps 2 and 4
+    OnlineTrainer(cfg).run(follow=False, max_batches=4)
+    ckpt_dir = os.path.abspath(cfg.run.model_dir)
+    assert os.path.isdir(os.path.join(ckpt_dir, "4"))
+
+    # the torn commit: step 5 renamed into place but its array payload
+    # never finished writing (metadata intact, data gone)
+    shutil.copytree(os.path.join(ckpt_dir, "4"), os.path.join(ckpt_dir, "5"))
+    shutil.rmtree(os.path.join(ckpt_dir, "5", "default", "d"))
+    shutil.rmtree(os.path.join(ckpt_dir, "5", "default", "ocdbt.process_0"),
+                  ignore_errors=True)
+
+    # phase 2: restart — must fall back to step 4's payload and finish
+    state = OnlineTrainer(cfg).run(follow=False)
+    assert int(state.step) == 6
+
+    ref = replay_to_state(cfg)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state.params),
+        jax.tree_util.tree_leaves(ref.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # the finished run committed a COMPLETE step 6 (odd torn step didn't
+    # block the final commit) and published consistently
+    from deepfm_tpu.checkpoint import Checkpointer
+
+    ck = Checkpointer(ckpt_dir)
+    assert 6 in ck.all_steps()
+    ck.close()
+    manifest = latest_manifest(cfg.run.servable_model_dir)
+    assert manifest.step == 6
+    assert manifest.param_hash == param_tree_hash(
+        state.params, state.model_state
+    )
+
+
+def test_commit_verifies_durability(tmp_path):
+    """commit_payload must fail LOUDLY when the save silently never
+    landed (the full-disk-swallowed-by-async failure mode) instead of
+    letting the trainer consume past an unpersisted cursor."""
+    from deepfm_tpu.online.trainer import commit_payload
+    from deepfm_tpu.train.step import create_train_state
+
+    cfg = _cfg(str(tmp_path))
+    state = create_train_state(cfg)
+
+    class _SilentlyFailingCkpt:
+        def save(self, payload, *, block=False):
+            return True  # claims success...
+
+        def all_steps(self):
+            return []    # ...but nothing landed
+
+    with pytest.raises(RuntimeError, match="did not become durable"):
+        commit_payload(_SilentlyFailingCkpt(), state, StreamCursor())
+
+
 def test_online_payload_checkpoint_roundtrip(tmp_path):
     from deepfm_tpu.checkpoint import Checkpointer
     from deepfm_tpu.train import create_train_state

@@ -235,6 +235,74 @@ def test_run_with_restarts_preempted_not_retried():
     assert calls["n"] == 1
 
 
+def test_reentrant_second_signal_escalates_deterministically(monkeypatch):
+    """The re-entrancy race: a second SIGTERM delivered INSIDE _handle —
+    after the old code's `is_set()` check, before its `set()` — made BOTH
+    invocations take the first-signal path and silently lose the
+    escalation.  The arrival counter must escalate exactly once no matter
+    the interleaving.  Simulated deterministically by re-entering _handle
+    from the first invocation's `time.time()` call (the exact window the
+    old check-then-set shape left open)."""
+    import time as _time
+
+    from deepfm_tpu.launch import preemption as P
+
+    calls = []
+    monkeypatch.setattr(P, "_escalate", lambda signum: calls.append(signum))
+    guard = PreemptionGuard(signals=(signal.SIGUSR1,))
+    real_time = _time.time
+    fired = {"n": 0}
+
+    def reenter():
+        if fired["n"] == 0:
+            fired["n"] = 1
+            guard._handle(signal.SIGUSR1, None)  # the nested second signal
+        return real_time()
+
+    monkeypatch.setattr(P.time, "time", reenter)
+    guard._handle(signal.SIGUSR1, None)
+    assert guard.should_stop
+    assert calls == [signal.SIGUSR1], (
+        f"expected exactly one deterministic escalation, got {calls}"
+    )
+
+
+def test_second_signal_after_request_stop_still_escalates(monkeypatch):
+    """Pre-fix behavior preserved: a cooperative stop counts as the first
+    arrival, so the next real signal escalates instead of being treated
+    as a fresh graceful request."""
+    from deepfm_tpu.launch import preemption as P
+
+    calls = []
+    monkeypatch.setattr(P, "_escalate", lambda signum: calls.append(signum))
+    guard = PreemptionGuard(signals=(signal.SIGUSR1,))
+    guard.request_stop()
+    guard._handle(signal.SIGUSR1, None)
+    assert calls == [signal.SIGUSR1]
+
+
+def test_early_handler_repeated_signal_escalates_once(monkeypatch):
+    """The pre-guard record-only handler carries the same arrival-counter
+    discipline: the second early signal escalates, exactly once."""
+    from deepfm_tpu.launch import preemption as P
+
+    calls = []
+    monkeypatch.setattr(P, "_escalate", lambda signum: calls.append(signum))
+    sig = signal.SIGUSR2
+    try:
+        assert P.install_early_handler(signals=(sig,))
+        handler = signal.getsignal(sig)
+        handler(sig, None)
+        assert not calls and P._EARLY_SIGNAL.is_set()
+        handler(sig, None)
+        handler(sig, None)
+        assert calls == [sig, sig]
+    finally:
+        P._EARLY_SIGNAL.clear()
+        P._EARLY_HANDLERS.pop(sig, None)
+        signal.signal(sig, signal.SIG_DFL)
+
+
 def test_outermost_exit_restores_default_after_early_handler():
     """ADVICE r04: once the last guard exits, the record-only early handler
     must NOT linger (it would swallow the first SIGTERM of post-training

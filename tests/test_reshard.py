@@ -110,6 +110,89 @@ def test_restore_refuses_data_loss(tmp_path):
     ck.close()
 
 
+def test_restore_saved_rows_not_dividing_target_partitions(tmp_path):
+    """M not dividing the SAVED table rows: a checkpoint padded for mp=1
+    (117 rows — odd) restored onto an mp=2 mesh (2 row partitions, padded
+    118) cannot stream-restore at the saved shape (117 % 2 != 0) and must
+    take the host-staged fallback for exactly those leaves — values and
+    pad-row ownership still exact, dtypes preserved."""
+    cfg = _cfg()
+    mesh_a = build_mesh(MeshConfig(data_parallel=8, model_parallel=1))
+    ctx_a = make_context(cfg, mesh_a)
+    assert ctx_a.cfg.model.feature_size == 117  # odd: no padding at mp=1
+    state = create_spmd_state(ctx_a)
+    step_a = make_spmd_train_step(ctx_a, donate=False)
+    for i in range(2):
+        state, _ = step_a(state, shard_batch(ctx_a, _batch(i)))
+    ck = Checkpointer(tmp_path / "ckpt")
+    ck.save(state, block=True)
+
+    mesh_b = build_mesh(MeshConfig(data_parallel=4, model_parallel=2))
+    ctx_b = make_context(cfg, mesh_b)
+    assert ctx_b.cfg.model.feature_size % 2 == 0  # padded for the shard
+    restored = restore_resharded(ck, ctx_b)
+    assert int(restored.step) == 2
+    for k in ("fm_w", "fm_v"):
+        old = np.asarray(jax.device_get(state.params[k]))[:V]
+        new = np.asarray(jax.device_get(restored.params[k]))
+        np.testing.assert_array_equal(old, new[:V])
+        # pad-row ownership: the grown rows belong to the LAST shard's
+        # window and are zero (never trained, never looked up)
+        np.testing.assert_array_equal(new[V:], np.zeros_like(new[V:]))
+        assert new.dtype == old.dtype
+    # training continues on the padded topology
+    step_b = make_spmd_train_step(ctx_b, donate=False)
+    cont, m = step_b(restored, shard_batch(ctx_b, _batch(5)))
+    assert np.isfinite(float(m["loss"]))
+    ck.close()
+
+
+def test_restore_grow_preserves_lazy_adam_slot_dtypes(tmp_path):
+    """M > N grow path with lazy Adam: the touched-rows-only optimizer's
+    slot tables (m/v, row-sharded like their params) must grow to the new
+    padding with VALUES carried, pad slots zero, and dtypes preserved —
+    a silently widened slot would double checkpoint bytes and recompile
+    the step."""
+    from deepfm_tpu.train.lazy import LazyAdamState
+
+    cfg = _cfg(lazy=True)
+    mesh_a = build_mesh(MeshConfig(data_parallel=8, model_parallel=1))
+    ctx_a = make_context(cfg, mesh_a)
+    state = create_spmd_state(ctx_a)
+    step_a = make_spmd_train_step(ctx_a, donate=False)
+    for i in range(3):
+        state, _ = step_a(state, shard_batch(ctx_a, _batch(i)))
+    ck = Checkpointer(tmp_path / "ckpt")
+    ck.save(state, block=True)
+
+    # grow: 117 saved rows -> 120 padded rows over 4 row shards
+    mesh_b = build_mesh(MeshConfig(data_parallel=2, model_parallel=4))
+    ctx_b = make_context(cfg, mesh_b)
+    restored = restore_resharded(ck, ctx_b)
+    _, old_lazy = state.opt_state
+    _, new_lazy = restored.opt_state
+    assert isinstance(new_lazy, LazyAdamState)
+    for slot_old, slot_new in ((old_lazy.m, new_lazy.m),
+                               (old_lazy.v, new_lazy.v)):
+        for k in slot_old:
+            a = np.asarray(jax.device_get(slot_old[k]))
+            b = np.asarray(jax.device_get(slot_new[k]))
+            assert b.dtype == a.dtype, f"{k}: {a.dtype} -> {b.dtype}"
+            assert b.shape[0] == ctx_b.cfg.model.feature_size
+            np.testing.assert_array_equal(a[:V], b[:V])
+            np.testing.assert_array_equal(b[V:], np.zeros_like(b[V:]))
+    # the moments actually carry signal (the slots were trained)
+    assert any(
+        np.asarray(jax.device_get(v)).any() for v in old_lazy.v.values()
+    )
+    # training continues: another lazy step on the grown topology
+    step_b = make_spmd_train_step(ctx_b, donate=False)
+    cont, m = step_b(restored, shard_batch(ctx_b, _batch(7)))
+    assert int(cont.step) == 4
+    assert np.isfinite(float(m["loss"]))
+    ck.close()
+
+
 def test_run_train_resumes_across_topology_change(tmp_path):
     """The driver's resume path: a job checkpointed on one mesh shape
     resumes transparently when relaunched with different mesh flags."""
