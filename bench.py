@@ -362,6 +362,18 @@ def run_variant(name: str) -> None:
 
 
 def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == "--obs":
+        # the observability overhead gate (benchmarks/obs_overhead.py):
+        # closed-loop serve throughput at concurrency 16, full trace +
+        # registry + flight pipeline vs bare, medians over interleaved
+        # trials; emits docs/BENCH_OBS.json and FAILS (exit 1) when the
+        # instrumented median falls more than 3% under bare.  Host-only
+        # by design — the obs layer never touches lowered code
+        # (audit_observability pins that), so chips are irrelevant here.
+        import obs_overhead
+
+        r = obs_overhead.main()
+        sys.exit(0 if r["within_noise"] else 1)
     if len(sys.argv) > 1 and sys.argv[1] == "--elastic":
         # the elastic chaos drill (benchmarks/elastic_drill.py): shrink
         # [2,4]→[1,4] and grow back mid-run under serving load; emits

@@ -1,0 +1,202 @@
+"""Observability overhead gate: instrumented vs bare serve throughput.
+
+The unified obs layer (deepfm_tpu/obs) sits on the serving hot path:
+every request crosses the metrics registry (labeled counters + the
+sliding-window latency histogram), and a traced request additionally
+mints a context at the handler, accumulates queue/dispatch spans in the
+MicroBatcher, and lands in the recent-traces ring.  This bench proves
+the tax is noise where it is actually paid — the REAL serve stack: a
+closed loop of 16 keep-alive HTTP clients posting TF-Serving-shape JSON
+predict requests through ``make_handler`` + ``MicroBatcher``.
+
+**Paired-window design.**  One server, one client fleet, continuous
+load; the tracer's head-based ``sample_rate`` is toggled per window
+through bare (0.0), the SHIPPED serving default
+(``obs.trace.DEFAULT_SAMPLE_RATE``) and full sampling (1.0), so
+adjacent windows differ ONLY in the per-request trace work.
+Everything a machine can drift on — thermal state, neighbor load,
+allocator state, connection reuse — is shared inside each window
+triple, and the verdict is the median of per-triple ratios.  (Two
+separate servers measured minutes apart showed ±5-10% drift on a
+shared CPU host — larger than the effect being gated; this design
+cancels it.)
+
+**What is gated.**  The 3% gate holds for the shipped configuration
+(default head sampling; the registry/counter layer is identical in both
+arms, and always on).  The full-sampling (every request traced) ratio
+is REPORTED alongside (``full_sampling_overhead_pct``) — that is the
+honest price of turning tracing to 100% on a GIL-bound CPU serve stack,
+and the reason head-based sampling is the default.
+
+The scored fn is a host matmul: the obs layer never enters lowered code
+(``audit_observability`` pins that), so a real XLA servable only makes
+each request more expensive and the relative overhead smaller — this is
+the adversarial setting for the gate.
+
+Artifact: ``docs/BENCH_OBS.json`` with ``overhead_pct`` and the
+``within_noise`` verdict (gate: <= 3% at concurrency 16).  Run via
+``python bench.py --obs`` (non-zero exit on gate failure) or directly.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+
+import numpy as np
+
+# runnable directly (`python benchmarks/obs_overhead.py`) or via bench.py
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CONCURRENCY = 16
+FIELDS = 39
+ROWS_PER_REQUEST = 16
+WINDOW_SECS = 0.75
+SETTLE_SECS = 0.05   # drain in-flight requests after a rate toggle
+PAIRS = 20           # bare/default/full window triples
+GATE_PCT = 3.0
+
+
+def _make_fn():
+    """A host 'model': [B, F] -> [B], a realistic per-dispatch compute
+    cost without needing a device in the loop."""
+    w1 = np.random.default_rng(0).standard_normal((FIELDS, 256)).astype(
+        np.float32)
+    w2 = np.random.default_rng(1).standard_normal((256, 1)).astype(
+        np.float32)
+
+    def fn(ids, vals):
+        h = np.maximum(vals @ w1, 0.0)
+        return 1.0 / (1.0 + np.exp(-(h @ w2)[:, 0]))
+
+    return fn
+
+
+def _request_body() -> bytes:
+    rng = np.random.default_rng(7)
+    inst = [{
+        "feat_ids": rng.integers(0, 1000, FIELDS).tolist(),
+        "feat_vals": rng.random(FIELDS).round(4).tolist(),
+    } for _ in range(ROWS_PER_REQUEST)]
+    return json.dumps({"instances": inst}).encode()
+
+
+def main(out_path: str | None = None) -> dict:
+    from deepfm_tpu.obs.trace import Tracer
+    from deepfm_tpu.serve.batcher import MicroBatcher
+    from deepfm_tpu.serve.server import ScoringHTTPServer, make_handler
+
+    body = _request_body()
+    engine = MicroBatcher(_make_fn(), FIELDS, buckets=(16, 64, 256),
+                          max_wait_ms=0.5, name="obs-bench")
+    tracer = Tracer("obs-bench", sample_rate=0.0, capacity=256)
+    httpd = ScoringHTTPServer(
+        ("127.0.0.1", 0), make_handler(engine, "deepfm", tracer=tracer))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    port = httpd.server_address[1]
+
+    stop = threading.Event()
+    done = [0] * CONCURRENCY
+
+    def client(i):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        try:
+            while not stop.is_set():
+                conn.request(
+                    "POST", "/v1/models/deepfm:predict", body,
+                    {"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                resp.read()
+                assert resp.status == 200, resp.status
+                done[i] += 1
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(CONCURRENCY)]
+    for t in threads:
+        t.start()
+    from deepfm_tpu.obs.trace import DEFAULT_SAMPLE_RATE
+
+    try:
+        time.sleep(1.0)  # warm-up: connections, allocator, first buckets
+        bare, inst, full = [], [], []
+        inst_ratios, full_ratios = [], []
+        arms = [(0.0, bare), (DEFAULT_SAMPLE_RATE, inst), (1.0, full)]
+        for n in range(PAIRS):
+            # rotate the in-triple order each round: window-scale noise
+            # here is bursty (coalescing phase, GC), and a fixed order
+            # would alias any position-in-cycle effect onto one arm
+            for k in range(3):
+                rate, sink = arms[(n + k) % 3]
+                tracer.sample_rate = rate
+                time.sleep(SETTLE_SECS)  # in-flight stragglers drain
+                before = sum(done)
+                t0 = time.perf_counter()
+                time.sleep(WINDOW_SECS)
+                elapsed = time.perf_counter() - t0
+                sink.append(
+                    ROWS_PER_REQUEST * (sum(done) - before) / elapsed
+                )
+            inst_ratios.append(inst[-1] / bare[-1])
+            full_ratios.append(full[-1] / bare[-1])
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+        httpd.shutdown()
+        engine.close()
+
+    def _trimmed_mean(xs, drop=2):
+        """Mean with the `drop` highest and lowest removed: window noise
+        here is bursty, and a plain median of ~PAIRS samples still
+        wobbles by more than the effect under test."""
+        xs = sorted(xs)[drop:-drop] if len(xs) > 2 * drop else sorted(xs)
+        return sum(xs) / len(xs)
+
+    overhead_pct = round(100.0 * (1.0 - _trimmed_mean(inst_ratios)), 2)
+    full_pct = round(100.0 * (1.0 - _trimmed_mean(full_ratios)), 2)
+    result = {
+        "bench": "obs_overhead",
+        "mode": "http_closed_loop_toggled_windows",
+        "concurrency": CONCURRENCY,
+        "rows_per_request": ROWS_PER_REQUEST,
+        "window_secs": WINDOW_SECS,
+        "pairs": PAIRS,
+        "sample_rate_default": DEFAULT_SAMPLE_RATE,
+        "bare_rows_per_sec": round(statistics.median(bare), 1),
+        "instrumented_rows_per_sec": round(statistics.median(inst), 1),
+        "full_sampling_rows_per_sec": round(statistics.median(full), 1),
+        "bare_windows": [round(x, 1) for x in bare],
+        "instrumented_windows": [round(x, 1) for x in inst],
+        "full_sampling_windows": [round(x, 1) for x in full],
+        "paired_ratios": [round(r, 4) for r in inst_ratios],
+        "full_sampling_ratios": [round(r, 4) for r in full_ratios],
+        "overhead_pct": overhead_pct,
+        "full_sampling_overhead_pct": full_pct,
+        "gate_pct": GATE_PCT,
+        "within_noise": overhead_pct <= GATE_PCT,
+        "traces_recorded": tracer.traces_total,
+        "recorded_unix_time": int(time.time()),
+    }
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "docs", "BENCH_OBS.json",
+        )
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    r = main()
+    raise SystemExit(0 if r["within_noise"] else 1)

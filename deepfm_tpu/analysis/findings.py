@@ -84,6 +84,12 @@ RULES = {
         "overflow fallback in alltoall mode, or a blind detector in psum "
         "mode (parallel/embedding.py shard_exchange)"
     ),
+    "trace-observability": (
+        "observability instrumentation leaked into lowered code: a host "
+        "callback (registry/trace call) in the jitted graph, or a "
+        "host-timer value captured by the trace (timers must wrap the "
+        "dispatch boundary, obs/)"
+    ),
 }
 
 

@@ -79,10 +79,19 @@ not a benchmark:
   same-spec payloads lower identically (a group swap is a cache hit —
   no mixed-generation executable can exist).
 
+* **observability audit** — the unified obs layer (``deepfm_tpu/obs``)
+  must never enter lowered code: the real serving predict and train step
+  lower under ``transfer_guard('disallow')`` with NO host callbacks in
+  the module (a registry/trace call smuggled under jit lowers as a
+  ``custom_call @..callback`` the scanner catches) and lower
+  deterministically across fresh builds (a host-timer value closed over
+  by the trace bakes a different constant per retrace).  Timers wrap
+  dispatch boundaries on the host — never traced values.
+
 Failures are reported as the same :class:`~.findings.Finding` records as
 engine 1 (rules ``trace-transfer`` / ``trace-recompile`` /
-``trace-donation`` / ``trace-dtype``) so the CLI, baseline, and JSON
-output treat both engines uniformly.
+``trace-donation`` / ``trace-dtype`` / ``trace-observability``) so the
+CLI, baseline, and JSON output treat both engines uniformly.
 """
 
 from __future__ import annotations
@@ -1320,6 +1329,138 @@ def audit_elastic(cfg=None, reshard_builder=None) -> list[Finding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# observability contract (unified obs layer, deepfm_tpu/obs)
+
+# markers of host callbacks in lowered StableHLO: anything io_callback /
+# pure_callback / debug.callback lowers to a custom_call whose target
+# carries "callback" — the shape a registry/trace call smuggled under jit
+# takes when it does not crash the trace outright
+_CALLBACK_MARKER = "callback"
+
+
+def _check_obs_lowering(name: str, texts: list[str], where: str
+                        ) -> list[Finding]:
+    out: list[Finding] = []
+    cb_lines = [
+        ln.strip()[:160] for ln in texts[0].splitlines()
+        if "custom_call" in ln and _CALLBACK_MARKER in ln.lower()
+    ]
+    if cb_lines:
+        out.append(_finding(
+            "trace-observability",
+            f"the jitted {name} lowers WITH a host callback "
+            f"({len(cb_lines)} custom_call(s), first: {cb_lines[0]!r}) — "
+            f"a registry/trace call entered the lowered graph and will "
+            f"sync the device on every dispatch",
+            hint="instrument AROUND the dispatch on the host "
+                 "(obs/metrics.py, obs/trace.py); never inside jit",
+            where=where, slug=f"obs-{name}-callback",
+        ))
+    if len(texts) > 1 and texts[0] != texts[1]:
+        out.append(_finding(
+            "trace-observability",
+            f"two successive lowerings of the jitted {name} differ — a "
+            f"host-side value (a wall-clock/perf_counter reading, a "
+            f"sequence number) was captured into the trace, so every "
+            f"retrace bakes a different executable",
+            hint="host timers must wrap the dispatch boundary, never "
+                 "close over traced values (obs/trace.py span discipline)",
+            where=where, slug=f"obs-{name}-nondeterministic",
+        ))
+    return out
+
+
+def audit_observability(cfg=None, predict_builder=None,
+                        step_builder=None) -> list[Finding]:
+    """The unified-observability contract: instrumentation NEVER enters
+    lowered code.  The real serving predict
+    (``serve.reload.build_predict_with`` — what the instrumented
+    MicroBatcher dispatches) and the canonical train step (what the
+    ``StepPhases``-timed loop dispatches) must still
+
+    * lower under ``jax.transfer_guard("disallow")`` (a registry call on
+      a traced value concretizes it or forces a transfer — either way
+      the lowering raises here);
+    * contain **no host callbacks** in the lowered module (a
+      ``debug.callback``/``io_callback`` into a metrics registry lowers
+      as a ``custom_call`` the scanner catches);
+    * lower **deterministically** (two successive lowerings identical):
+      a host-timer value closed over by the traced function bakes a
+      different constant per retrace — the classic "time the kernel from
+      inside" mistake.
+
+    ``predict_builder(model, cfg)`` / ``step_builder(cfg)`` let the
+    seeded-violation tests (tests/test_analysis.py) feed an
+    instrumented-inside-jit predict and a timer-baking step through the
+    same checks."""
+    import jax
+
+    out: list[Finding] = []
+    cfg = cfg or _audit_cfg()
+    f = cfg.model.field_size
+    b = _default_buckets()[0]
+    args = (
+        jax.ShapeDtypeStruct((b, f), jax.numpy.int64),
+        jax.ShapeDtypeStruct((b, f), jax.numpy.float32),
+    )
+    # -- serving predict ----------------------------------------------------
+    from ..serve.reload import build_predict_with
+
+    where = "deepfm_tpu/obs/metrics.py"
+    model, payload = _abstract_payload(cfg)
+    build_p = predict_builder or build_predict_with
+    texts: list[str] = []
+    try:
+        with jax.transfer_guard("disallow"):
+            # TWO builder instances: jax.jit caches the trace per
+            # instance, so only a fresh build re-traces — which is what
+            # exposes a baked host-timer value (each trace reads a
+            # different clock)
+            for _ in range(2):
+                texts.append(
+                    build_p(model, cfg).lower(payload, *args).as_text()
+                )
+    except Exception as e:
+        out.append(_finding(
+            "trace-observability",
+            f"lowering the serving predict with the observability layer "
+            f"active raised {type(e).__name__}: {e} — a registry/trace "
+            f"call ran under trace (concretization or implicit transfer)",
+            hint="record metrics on the host around engine.score / the "
+                 "dispatch boundary, never inside the jitted fn",
+            where=where, slug="obs-predict-lower",
+        ))
+    else:
+        out.extend(_check_obs_lowering("predict", texts, where))
+    # -- train step ---------------------------------------------------------
+    from ..train.step import create_train_state, jitted_train_step
+
+    state = jax.eval_shape(lambda: create_train_state(cfg))
+    batch = _abstract_batch(cfg, cfg.data.batch_size)
+    build_s = step_builder or (lambda c: jitted_train_step(c))
+    texts = []
+    try:
+        with jax.transfer_guard("disallow"):
+            for _ in range(2):
+                texts.append(
+                    build_s(cfg).lower(state, batch).as_text()
+                )
+    except Exception as e:
+        out.append(_finding(
+            "trace-observability",
+            f"lowering the train step with the observability layer "
+            f"active raised {type(e).__name__}: {e} — step-phase timers "
+            f"or a registry call ran under trace",
+            hint="StepPhases wraps the dispatch on the host "
+                 "(train/loop.py); nothing records inside the step",
+            where=where, slug="obs-train-lower",
+        ))
+    else:
+        out.extend(_check_obs_lowering("train_step", texts, where))
+    return out
+
+
 def run_trace_audit(cfg=None) -> list[Finding]:
     """All engine-2 audits against the real entrypoints (abstract values
     only; no step executes).  Importing jax is the price of admission —
@@ -1333,4 +1474,5 @@ def run_trace_audit(cfg=None) -> list[Finding]:
     findings.extend(audit_sharded_predict(cfg))
     findings.extend(audit_funnel(cfg))
     findings.extend(audit_elastic(cfg))
+    findings.extend(audit_observability(cfg))
     return findings

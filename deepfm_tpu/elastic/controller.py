@@ -57,6 +57,7 @@ from ..parallel import (
     make_spmd_train_step,
     shard_batch,
 )
+from ..obs import flight as obs_flight
 from ..parallel.spmd import TABLE_KEYS
 from ..train.step import TrainState
 from ..utils import MetricLogger
@@ -141,6 +142,10 @@ class ElasticTrainer:
     def _event(self, kind: str, **fields) -> None:
         self.lifecycle.append({"kind": kind, **fields})
         self._log.event(f"elastic_{kind}", **fields)
+        # the same lifecycle feeds the crash flight recorder (obs/flight):
+        # a chaos drill's drain/reshard/resume lands in one correlated
+        # timeline with swaps, breaker trips and ejections
+        obs_flight.record(f"elastic_{kind}", subsystem="elastic", **fields)
 
     def _current_epoch(self) -> int:
         """The registry's live membership epoch.  A polling registry

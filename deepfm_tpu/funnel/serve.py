@@ -39,6 +39,8 @@ import time
 
 import numpy as np
 
+from ..obs import flight as obs_flight
+from ..obs.metrics import MetricsRegistry
 from ..online.publisher import (
     Manifest,
     fetch_version,
@@ -76,27 +78,6 @@ class FunnelHolder(SwappableParams):
             return self.version, iv
 
 
-class _Window:
-    """Fixed-ring latency reservoir (the batcher/router idiom)."""
-
-    def __init__(self, size: int = 2048):
-        self._lat = np.zeros(size, np.float64)
-        self._n = 0
-
-    def record(self, seconds: float) -> None:
-        self._lat[self._n % self._lat.size] = seconds
-        self._n += 1
-
-    def snapshot(self) -> dict:
-        n = min(self._n, self._lat.size)
-        out: dict = {"count": int(self._n)}
-        if n:
-            w = np.sort(self._lat[:n])
-            for name, q in (("p50", 0.50), ("p99", 0.99)):
-                out[name] = round(1e3 * float(w[int((n - 1) * q)]), 3)
-        return out
-
-
 def _canary_probes(ctx: FunnelContext, rows: int):
     """Spread in-vocab query ids + zero ranking features (the HotSwapper
     probe construction, both funnel widths)."""
@@ -131,6 +112,7 @@ class FunnelScorer:
         max_queue_rows: int | None = None,
         precompile: bool = True,
         name: str = "recommend",
+        registry: MetricsRegistry | None = None,
     ):
         from ..parallel.mesh import mesh_shape
 
@@ -165,13 +147,24 @@ class FunnelScorer:
         self.candidates_total = 0
         self.retrieval_secs_total = 0.0
         self.merge_overflow_total = 0
-        self._retr_window = _Window()
-        self._rank_window = _Window()
+        # stage latency lives in the shared obs registry (one percentile
+        # implementation — obs/metrics.py SlidingWindow); the funnel
+        # section reports p50/p99 as before
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        stage_hist = self.registry.histogram(
+            "deepfm_funnel_stage_seconds",
+            "per-dispatch funnel stage latency", labels=("stage",),
+            quantiles=(0.50, 0.99),
+        )
+        self._retr_window = stage_hist.labels("retrieval")
+        self._rank_window = stage_hist.labels("rank")
         self.engine = MicroBatcher(
             self._funnel_fn,
             self.ctx.user_fields + self.ctx.rank_fields,
             buckets=buckets, max_wait_ms=max_wait_ms,
             max_queue_rows=max_queue_rows, name=name,
+            registry=self.registry,
         )
         # consumers that wrap the ENGINE in the generic handler (the pool
         # member) still get the funnel metrics section — same hasattr
@@ -208,11 +201,11 @@ class FunnelScorer:
             # percentiles for hours after boot
             return pack
         overflow = bool((np.asarray(cand) < 0).any())
+        self._retr_window.observe(t1 - t0)
+        self._rank_window.observe(t2 - t1)
         with self._flock:
             self.candidates_total += ids.shape[0] * self.ctx.top_k
             self.retrieval_secs_total += t1 - t0
-            self._retr_window.record(t1 - t0)
-            self._rank_window.record(t2 - t1)
             if overflow:
                 # the merge returned pad entries: the corpus holds fewer
                 # valid items than top_k asks for
@@ -515,11 +508,20 @@ class FunnelSwapper:
                 self.last_error = (
                     None if drained else "drain timeout (swap still applied)"
                 )
+            obs_flight.record(
+                "swap_commit", subsystem="funnel",
+                version=staged_manifest.version, drained=bool(drained),
+            )
             return True
         except Exception as e:
             with self._lock:
                 self.rollbacks_total += 1
                 self.last_error = f"{type(e).__name__}: {e}"
+            obs_flight.record(
+                "swap_rollback", subsystem="funnel",
+                version=manifest.version,
+                error=f"{type(e).__name__}: {e}",
+            )
             return False
 
     def start(self) -> "FunnelSwapper":
@@ -588,14 +590,16 @@ def handle_recommend(scorer: FunnelScorer, req: dict) -> tuple[int, dict]:
 
 
 def make_funnel_handler(scorer: FunnelScorer, model_name: str,
-                        reload_status=None, readiness=None):
+                        reload_status=None, readiness=None, tracer=None):
     """The funnel HTTP surface: serve/server.py's handler (health,
-    readiness, status, ``/v1/metrics`` with the ``funnel`` section) with
-    POST routed exclusively to ``/v1/recommend``."""
+    readiness, status, ``/v1/metrics`` with the ``funnel`` section,
+    ``GET /metrics``/``/v1/trace/recent``/``/v1/flight``) with POST
+    routed exclusively to ``/v1/recommend`` — traced like predict."""
     from ..serve.server import make_handler
 
     base = make_handler(scorer, model_name, reload_status=reload_status,
-                        readiness=readiness)
+                        readiness=readiness, registry=scorer.registry,
+                        tracer=tracer)
 
     class FunnelHandler(base):
         def do_POST(self):  # noqa: N802
@@ -604,13 +608,20 @@ def make_funnel_handler(scorer: FunnelScorer, model_name: str,
                     "error": f"unknown path {self.path!r} (funnel "
                              f"servables serve POST {RECOMMEND_PATH})"
                 })
+            ctx = self.obs_tracer.begin("recommend", self.headers)
+            token = self.obs_tracer.activate(ctx)
+            self._obs_status = None
             try:
-                length = int(self.headers.get("Content-Length", "0"))
-                req = json.loads(self.rfile.read(length))
-            except Exception as e:
-                return self._send(400, {"error": f"{type(e).__name__}: {e}"})
-            code, doc = handle_recommend(scorer, req)
-            self._send(code, doc)
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    req = json.loads(self.rfile.read(length))
+                except Exception as e:
+                    return self._send(
+                        400, {"error": f"{type(e).__name__}: {e}"})
+                code, doc = handle_recommend(scorer, req)
+                self._send(code, doc)
+            finally:
+                self.obs_tracer.finish(ctx, token, status=self._obs_status)
 
     return FunnelHandler
 
@@ -630,6 +641,8 @@ def serve_funnel(
     return_n: int = 0,
     data_parallel: int = 1,
     model_parallel: int = 0,
+    trace_sample_rate: float | None = None,
+    trace_export: str | None = None,
     ready: threading.Event | None = None,
 ) -> None:
     """Blocking single-process funnel server (``serve_forever`` delegates
@@ -671,9 +684,18 @@ def serve_funnel(
             doc["ready"] = breaker.get("state") != "open"
         return doc
 
-    handler = make_funnel_handler(scorer, model_name,
-                                  reload_status=reload_status,
-                                  readiness=readiness)
+    from ..obs.trace import DEFAULT_SAMPLE_RATE, Tracer
+
+    handler = make_funnel_handler(
+        scorer, model_name, reload_status=reload_status,
+        readiness=readiness,
+        tracer=Tracer(
+            "funnel",
+            sample_rate=(DEFAULT_SAMPLE_RATE if trace_sample_rate is None
+                         else trace_sample_rate),
+            export_path=trace_export,
+        ),
+    )
     print(f"precompiled funnel bucket executables: {scorer.compile_secs}",
           file=sys.stderr)
     httpd = ScoringHTTPServer((host, port), handler)

@@ -43,6 +43,31 @@ _EARLY_SIGNAL = threading.Event()
 # guard's __exit__ can recognize it (see PreemptionGuard.__exit__)
 _EARLY_HANDLERS: dict[int, object] = {}
 
+# Observers of the FIRST stop request (signal or cooperative), e.g. the
+# flight recorder's termination dump (obs/flight.install).  Invoked from
+# the signal handler path, so every callback must be async-signal-tolerant
+# (no locks shared with the interrupted code) and is exception-isolated —
+# a broken observer must never eat the stop itself.
+_STOP_CALLBACKS: list = []
+
+
+def register_stop_callback(fn) -> None:
+    """``fn(signum_or_None)`` runs once per stop request (SIGTERM/SIGINT
+    or ``request_stop``), before escalation logic.  See obs/flight.py."""
+    _STOP_CALLBACKS.append(fn)
+
+
+def _notify_stop(signum=None) -> None:
+    import sys
+
+    for fn in list(_STOP_CALLBACKS):
+        try:
+            fn(signum)
+        except Exception as e:
+            # observers must never break the stop path; best-effort note
+            print(f"stop callback failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+
 
 def install_early_handler(signals=_DEFAULT_SIGNALS) -> bool:
     """Install a minimal record-only handler for the pre-guard window.
@@ -65,6 +90,8 @@ def install_early_handler(signals=_DEFAULT_SIGNALS) -> bool:
     def _record(signum, frame) -> None:
         n = next(arrivals)  # atomic under the GIL (one bytecode)
         _EARLY_SIGNAL.set()
+        if n == 0:
+            _notify_stop(signum)
         if n > 0:
             _escalate(signum)
 
@@ -149,6 +176,11 @@ class PreemptionGuard:
         n = next(self._arrivals)
         self.signaled_at = time.time()
         self._stop.set()
+        if n == 0:
+            # first stop request: let observers (flight-recorder dump,
+            # obs/flight.py) capture the incident timeline before any
+            # escalation can terminate the process
+            _notify_stop(signum)
         if n > 0:
             # repeated signal while a graceful stop is already pending
             # (e.g. Ctrl-C during a long compile): escalate to default
@@ -160,9 +192,11 @@ class PreemptionGuard:
         Draws an arrival slot like a real signal, so a SIGTERM landing
         after a cooperative stop still escalates (the pre-fix behavior,
         preserved)."""
-        next(self._arrivals)
+        n = next(self._arrivals)
         self.signaled_at = time.time()
         self._stop.set()
+        if n == 0:
+            _notify_stop(None)
 
     @property
     def should_stop(self) -> bool:

@@ -1,0 +1,54 @@
+"""Unified observability: metrics registry, request tracing, flight
+recorder.
+
+One layer, three surfaces, shared by train→publish→serve:
+
+* :mod:`.metrics` — typed, labeled Counter/Gauge/Histogram registry with
+  ONE sliding-window percentile implementation (the snapshot idiom that
+  used to be copied across the MicroBatcher, the pool router and the
+  funnel scorer) and Prometheus text exposition (``GET /metrics``).
+* :mod:`.trace` — end-to-end request tracing: an ``X-Trace-Id`` context
+  minted at the router (or accepted from the client), propagated through
+  worker predict/recommend and the MicroBatcher so each request
+  accumulates per-stage spans; bounded recent-traces buffer behind
+  ``GET /v1/trace/recent``; host-side step-phase timers for the train
+  loop.
+* :mod:`.flight` — a bounded ring of structured events every subsystem
+  appends to through one hook, dumped as JSONL on SIGTERM/crash (riding
+  PreemptionGuard) and on demand via ``GET /v1/flight``.
+
+Everything here is host-side and dependency-light (numpy only, no jax):
+instrumentation must never enter lowered code — the ``audit_observability``
+trace contract (analysis/trace_audit.py) proves the jitted predict and
+train step stay free of host callbacks and baked timer values.
+"""
+
+from .flight import FlightRecorder, get_recorder, record
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry, SlidingWindow
+from .trace import (
+    SPAN_HEADER,
+    TRACE_HEADER,
+    StepPhases,
+    TraceContext,
+    Tracer,
+    current_trace,
+    span,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "SlidingWindow",
+    "Tracer",
+    "TraceContext",
+    "StepPhases",
+    "current_trace",
+    "span",
+    "TRACE_HEADER",
+    "SPAN_HEADER",
+    "FlightRecorder",
+    "get_recorder",
+    "record",
+]

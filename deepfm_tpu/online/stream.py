@@ -254,6 +254,12 @@ class EventLogReader:
                 self._fail_counts.pop(name, None)
         log = logging.getLogger(__name__)
         if quarantine:
+            from ..obs import flight as obs_flight
+
+            obs_flight.record(
+                "segment_quarantine", subsystem="stream", segment=name,
+                failures=n, error=f"{type(err).__name__}: {err}",
+            )
             log.warning(
                 "segment %s quarantined after %d failed reads "
                 "(skipping it; last error: %s)", name, n, err)

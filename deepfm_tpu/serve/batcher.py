@@ -32,7 +32,16 @@ This module is the full engine, GSPMD-style thinking applied to serving
   when the queue is idle (it chunks through the largest bucket).
 * **Metrics.**  Request/row/dispatch counters, a per-bucket batch-size
   histogram, live queue depth, and p50/p95/p99 end-to-end latency over a
-  sliding window — served by ``GET /v1/metrics`` (serve/server.py).
+  sliding window — all held in the shared observability registry
+  (obs/metrics.py), served as the same ``/v1/metrics`` JSON document as
+  ever (schema-pinned) and scrape-able via ``GET /metrics`` in
+  Prometheus text format (serve/server.py).
+* **Tracing.**  When the calling thread carries an active trace context
+  (obs/trace.py — set by the HTTP handler), the engine records
+  per-request spans: queue wait and each device dispatch (bucket chosen,
+  rows coalesced, padding).  Timers wrap the dispatch boundary on the
+  host — nothing is ever recorded inside the jitted executable
+  (``audit_observability`` pins this).
 
 Correctness invariants: shape validation happens on the *caller's* thread
 (a malformed request fails alone, never poisoning a batch); per-request
@@ -48,6 +57,9 @@ from collections import deque
 from typing import Callable, Sequence
 
 import numpy as np
+
+from ..obs.metrics import MetricsRegistry
+from ..obs.trace import current_trace
 
 
 class OverloadedError(RuntimeError):
@@ -125,78 +137,101 @@ def check_features(ids: np.ndarray, vals: np.ndarray, fields: int) -> None:
 
 
 class _Metrics:
-    """Thread-safe engine counters + a sliding latency window.
+    """Engine counters + sliding latency window, held in the shared
+    observability registry (obs/metrics.py).
 
-    The latency reservoir is a fixed ring (last ``window`` completed
-    requests): percentile snapshots reflect recent traffic, stay O(window)
-    to compute, and never grow with uptime."""
+    Families are labeled ``engine=<name>`` so one registry carries many
+    engines (the two-tower scorer's user/item pair, a funnel member's
+    recommend engine); ``snapshot()`` re-renders the SAME ``/v1/metrics``
+    JSON document the pre-registry counters produced — the schema is
+    pinned by tests — while ``GET /metrics`` scrapes the registry
+    directly."""
 
-    def __init__(self, buckets: Sequence[int], window: int = 4096):
-        self._lock = threading.Lock()
-        self.requests_total = 0
-        self.rows_total = 0
-        self.dispatches_total = 0
-        self.padded_rows_total = 0   # dispatched minus real rows (waste)
-        self.rejected_total = 0
-        self.batch_size_hist = {int(b): 0 for b in buckets}
-        self._lat = np.zeros(window, np.float64)
-        self._lat_n = 0               # total recorded (ring write cursor)
+    def __init__(self, buckets: Sequence[int], *, name: str = "predict",
+                 registry: MetricsRegistry | None = None,
+                 window: int = 4096):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        r = self.registry
+        self._requests = r.counter(
+            "deepfm_serve_requests_total",
+            "requests admitted to the micro-batching engine",
+            labels=("engine",),
+        ).labels(name)
+        self._rows = r.counter(
+            "deepfm_serve_rows_total", "rows admitted", labels=("engine",),
+        ).labels(name)
+        self._rejected = r.counter(
+            "deepfm_serve_rejected_total",
+            "requests shed by queue backpressure", labels=("engine",),
+        ).labels(name)
+        self._padded = r.counter(
+            "deepfm_serve_padded_rows_total",
+            "dispatched minus real rows (padding waste)",
+            labels=("engine",),
+        ).labels(name)
+        dispatches = r.counter(
+            "deepfm_serve_dispatches_total",
+            "device dispatches by bucket shape",
+            labels=("engine", "bucket"),
+        )
+        # pre-create every bucket child so the histogram renders zeros
+        # (the pinned batch_size_hist schema lists all buckets up front)
+        self._dispatch_by_bucket = {
+            int(b): dispatches.labels(name, str(int(b))) for b in buckets
+        }
+        self._latency = r.histogram(
+            "deepfm_serve_latency_seconds",
+            "end-to-end request latency through the engine",
+            labels=("engine",), window=window,
+        ).labels(name)
 
     def record_admit(self, rows: int) -> None:
-        with self._lock:
-            self.requests_total += 1
-            self.rows_total += rows
+        self._requests.inc()
+        self._rows.inc(rows)
 
     def record_reject(self) -> None:
-        with self._lock:
-            self.rejected_total += 1
+        self._rejected.inc()
 
     def record_dispatch(self, bucket: int, rows: int) -> None:
-        with self._lock:
-            self.dispatches_total += 1
-            self.padded_rows_total += bucket - rows
-            self.batch_size_hist[bucket] += 1
+        self._padded.inc(bucket - rows)
+        self._dispatch_by_bucket[bucket].inc()
 
     def record_latency(self, seconds: float) -> None:
-        with self._lock:
-            self._lat[self._lat_n % self._lat.size] = seconds
-            self._lat_n += 1
+        self._latency.observe(seconds)
 
     def snapshot(self) -> dict:
-        with self._lock:
-            n = min(self._lat_n, self._lat.size)
-            window = np.sort(self._lat[:n]) if n else None
-            out = {
-                "requests_total": self.requests_total,
-                "rows_total": self.rows_total,
-                "dispatches_total": self.dispatches_total,
-                "padded_rows_total": self.padded_rows_total,
-                "rejected_total": self.rejected_total,
-                "batch_size_hist": {
-                    str(k): v for k, v in sorted(self.batch_size_hist.items())
-                },
-            }
-        lat = {"count": int(self._lat_n)}
-        if window is not None:
-            for name, q in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
-                lat[name] = round(1e3 * float(window[int((n - 1) * q)]), 3)
-            lat["max"] = round(1e3 * float(window[-1]), 3)
-        out["latency_ms"] = lat
-        return out
+        hist = {
+            str(b): int(c.value)
+            for b, c in sorted(self._dispatch_by_bucket.items())
+        }
+        return {
+            "requests_total": int(self._requests.value),
+            "rows_total": int(self._rows.value),
+            "dispatches_total": sum(hist.values()),
+            "padded_rows_total": int(self._padded.value),
+            "rejected_total": int(self._rejected.value),
+            "batch_size_hist": hist,
+            "latency_ms": self._latency.snapshot(include_max=True),
+        }
 
 
 class _Request:
     """One caller's submission: output assembled from dispatch slices."""
 
-    __slots__ = ("rows", "out", "remaining", "done", "error", "t_submit")
+    __slots__ = ("rows", "out", "remaining", "done", "error", "t_submit",
+                 "trace", "t_dispatch")
 
-    def __init__(self, rows: int, chunks: int):
+    def __init__(self, rows: int, chunks: int, trace=None):
         self.rows = rows
         self.out: np.ndarray | None = None   # allocated on first slice
         self.remaining = chunks
         self.done = threading.Event()
         self.error: BaseException | None = None
         self.t_submit = time.perf_counter()
+        # the caller's trace context (obs/trace.py), captured on the
+        # submitting thread so the dispatch thread can attach spans
+        self.trace = trace
+        self.t_dispatch: float | None = None  # first dispatch start
 
 
 class MicroBatcher:
@@ -219,6 +254,7 @@ class MicroBatcher:
         max_wait_ms: float = 2.0,
         max_queue_rows: int | None = None,
         name: str = "predict",
+        registry: MetricsRegistry | None = None,
     ):
         if not buckets:
             raise ValueError("need at least one bucket size")
@@ -235,7 +271,23 @@ class MicroBatcher:
             else int(max_queue_rows)
         )
         self.name = name
-        self.metrics = _Metrics(self._buckets)
+        # precomputed span names: the trace hot path must not pay an
+        # f-string per request
+        self._span_queue = f"{name}.queue"
+        self._span_dispatch = f"{name}.dispatch"
+        # ``registry`` shares one obs registry across a process's engines
+        # (served by GET /metrics); None keeps the engine hermetic
+        self.metrics = _Metrics(self._buckets, name=name, registry=registry)
+        self.registry = self.metrics.registry
+        self._g_queue_rows = self.registry.gauge(
+            "deepfm_serve_queue_rows", "rows queued awaiting dispatch",
+            labels=("engine",),
+        ).labels(name)
+        self._g_queue_requests = self.registry.gauge(
+            "deepfm_serve_queue_requests", "queued request chunks",
+            labels=("engine",),
+        ).labels(name)
+        self.registry.on_collect(self._refresh_queue_gauges)
         self._cond = threading.Condition()
         # queue items: (request, req_offset, ids_chunk, vals_chunk, arrival)
         self._queue: deque[tuple] = deque()
@@ -287,7 +339,7 @@ class MicroBatcher:
         # so the worker never has to slice mid-item
         cap = self._buckets[-1]
         starts = list(admission_starts(n, cap))
-        req = _Request(n, len(starts))
+        req = _Request(n, len(starts), trace=current_trace())
         with self._cond:
             if self._closed:
                 raise RuntimeError(
@@ -314,12 +366,25 @@ class MicroBatcher:
         self.metrics.record_admit(n)
         req.done.wait()
         self.metrics.record_latency(time.perf_counter() - req.t_submit)
+        if req.trace is not None and req.t_dispatch is not None:
+            # queue wait = admission to first device dispatch; the
+            # dispatch spans themselves were recorded by the worker
+            req.trace.add_span(
+                self._span_queue, req.t_submit, req.t_dispatch, rows=n,
+            )
         if req.error is not None:
             raise req.error
         return req.out
 
     def score_instances(self, instances: list[dict]) -> np.ndarray:
         return self.score(*instances_to_arrays(instances))
+
+    def _refresh_queue_gauges(self) -> None:
+        """Pre-scrape hook: surface live queue depth as gauges."""
+        with self._cond:
+            rows, reqs = self._queued_rows, len(self._queue)
+        self._g_queue_rows.set(rows)
+        self._g_queue_requests.set(reqs)
 
     def metrics_snapshot(self) -> dict:
         with self._cond:
@@ -389,6 +454,10 @@ class MicroBatcher:
 
     def _dispatch(self, batch: list[tuple], rows: int) -> None:
         bucket = self._pick_bucket(rows)
+        t0 = time.perf_counter()
+        for req, *_ in batch:
+            if req.t_dispatch is None:
+                req.t_dispatch = t0
         try:
             ids = np.zeros((bucket, self._fields), np.int64)
             vals = np.zeros((bucket, self._fields), np.float32)
@@ -399,6 +468,15 @@ class MicroBatcher:
                 off += cids.shape[0]
             res = np.asarray(self._fn(ids, vals))
             self.metrics.record_dispatch(bucket, rows)
+            t1 = time.perf_counter()
+            for req, *_ in batch:
+                if req.trace is not None:
+                    # host-side timer AROUND the dispatch boundary — the
+                    # jitted fn itself carries no instrumentation
+                    req.trace.add_span(
+                        self._span_dispatch, t0, t1, bucket=bucket,
+                        rows_coalesced=rows, padded=bucket - rows,
+                    )
             off = 0
             for req, req_off, cids, _cv, _t in batch:
                 k = cids.shape[0]

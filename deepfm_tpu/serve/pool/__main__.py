@@ -47,6 +47,9 @@ def _member_argv(args, group: str, index: int, port: int) -> list[str]:
         argv += ["--funnel-top-k", str(args.funnel_top_k)]
     if args.funnel_return_n:
         argv += ["--funnel-return-n", str(args.funnel_return_n)]
+    if args.flight_dump:
+        # one timeline file per process: members suffix their group name
+        argv += ["--flight-dump", f"{args.flight_dump}.{group}"]
     return argv
 
 
@@ -97,6 +100,13 @@ def _supervise_member(args, group: str, index: int, port: int,
 def _run_member(args) -> int:
     from .worker import serve_member
 
+    if args.flight_dump:
+        from ...obs import flight as obs_flight
+
+        obs_flight.install(args.flight_dump)
+        # the supervisor tears members down with SIGTERM (terminate());
+        # dump the timeline on the way out, then die as before
+        obs_flight.dump_on_signal()
     serve_member(
         args.servable, group=args.group,
         data_parallel=args.group_dp, model_parallel=args.group_mp,
@@ -151,6 +161,13 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--health-interval", type=float, default=1.0)
     ap.add_argument("--max-restarts", type=int, default=10)
     ap.add_argument("--restart-backoff-secs", type=float, default=1.0)
+    ap.add_argument(
+        "--flight-dump", default="",
+        help="arm the flight-recorder termination dump (obs/flight.py): "
+             "the supervisor/router writes this JSONL on shutdown or "
+             "crash, each member writes <path>.<group> on SIGTERM; the "
+             "live rings stay at GET /v1/flight",
+    )
     # internal: the re-exec member entry
     ap.add_argument("--member-entry", action="store_true",
                     help=argparse.SUPPRESS)
@@ -172,6 +189,14 @@ def main(argv: list[str] | None = None) -> int:
         raise KeyboardInterrupt
 
     signal.signal(signal.SIGTERM, _terminate)
+
+    if args.flight_dump:
+        from ...obs import flight as obs_flight
+
+        # SIGTERM raises KeyboardInterrupt (above) and unwinds through
+        # the finally below, which dumps — so crash coverage (install's
+        # excepthook) plus clean/killed shutdown both leave the timeline
+        obs_flight.install(args.flight_dump)
 
     stop = threading.Event()
     group_names = [f"g{i}" for i in range(args.groups)]
@@ -231,6 +256,10 @@ def main(argv: list[str] | None = None) -> int:
             s.stop()
         for t in supervisors:
             t.join(timeout=40)
+        if args.flight_dump:
+            from ...obs import flight as obs_flight
+
+            obs_flight.get_recorder().dump(reason="shutdown")
     return 0
 
 

@@ -44,9 +44,10 @@ import time
 import urllib.error
 import urllib.request
 
-import numpy as np
-
-from ..server import ScoringHTTPServer, _send_json
+from ...obs import flight as obs_flight
+from ...obs.metrics import MetricsRegistry
+from ...obs.trace import DEFAULT_SAMPLE_RATE, Tracer, current_trace
+from ..server import ScoringHTTPServer, _send_json, _send_text
 from http.server import BaseHTTPRequestHandler
 
 
@@ -105,27 +106,6 @@ class HashRing:
         return out
 
 
-class _Window:
-    """Per-group sliding latency window (the batcher's reservoir idiom)."""
-
-    def __init__(self, size: int = 2048):
-        self._lat = np.zeros(size, np.float64)
-        self._n = 0
-
-    def record(self, seconds: float) -> None:
-        self._lat[self._n % self._lat.size] = seconds
-        self._n += 1
-
-    def snapshot(self) -> dict:
-        n = min(self._n, self._lat.size)
-        out = {"count": int(self._n)}
-        if n:
-            w = np.sort(self._lat[:n])
-            for name, q in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
-                out[name] = round(1e3 * float(w[int((n - 1) * q)]), 3)
-        return out
-
-
 class _Member:
     __slots__ = ("url", "healthy", "fails", "inflight", "doc")
 
@@ -153,6 +133,8 @@ class Router:
         eject_after: int = 2,
         probe_interval_secs: float = 1.0,
         request_timeout_secs: float = 60.0,
+        registry: MetricsRegistry | None = None,
+        tracer: Tracer | None = None,
     ):
         if not groups:
             raise ValueError("router needs at least one shard-group")
@@ -168,16 +150,67 @@ class Router:
         self._timeout = float(request_timeout_secs)
         self._lock = threading.Lock()
         self._generation: dict[str, int] = {}
-        self._windows = {g: _Window() for g in groups}
-        self._group_requests = {g: 0 for g in groups}
-        self.requests_total = 0
-        self.retries_total = 0
-        self.skew_aborts_total = 0
-        self.ejections_total = 0
-        self.readmissions_total = 0
-        self.no_capacity_total = 0
+        # all counters/latency live in the shared obs registry
+        # (obs/metrics.py): /v1/metrics re-renders from it unchanged and
+        # GET /metrics scrapes it directly
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        # the router is where a request enters the pool: it is the trace
+        # HEAD (mints X-Trace-Id at the shipped sample rate, or adopts
+        # the client's — always recorded); members inherit the decision
+        # via the propagated headers
+        self.tracer = tracer if tracer is not None else Tracer(
+            "router", sample_rate=DEFAULT_SAMPLE_RATE)
+        r = self.registry
+        self._c_requests = r.counter(
+            "deepfm_router_requests_total", "requests routed")
+        self._c_retries = r.counter(
+            "deepfm_router_retries_total", "cross-member retry attempts")
+        self._c_skew = r.counter(
+            "deepfm_router_skew_aborts_total",
+            "409 generation-skew aborts observed")
+        self._c_ejections = r.counter(
+            "deepfm_router_ejections_total", "members ejected")
+        self._c_readmissions = r.counter(
+            "deepfm_router_readmissions_total", "members re-admitted")
+        self._c_no_capacity = r.counter(
+            "deepfm_router_no_capacity_total",
+            "requests refused with no healthy shard-group")
+        group_requests = r.counter(
+            "deepfm_router_group_requests_total",
+            "requests answered per shard-group", labels=("group",))
+        latency = r.histogram(
+            "deepfm_router_group_latency_seconds",
+            "router-measured member latency", labels=("group",))
+        self._group_requests = {g: group_requests.labels(g) for g in groups}
+        self._windows = {g: latency.labels(g) for g in groups}
         self._stop = threading.Event()
         self._prober: threading.Thread | None = None
+
+    # registry-backed totals, read-compatible with the pre-registry attrs
+    @property
+    def requests_total(self) -> int:
+        return int(self._c_requests.value)
+
+    @property
+    def retries_total(self) -> int:
+        return int(self._c_retries.value)
+
+    @property
+    def skew_aborts_total(self) -> int:
+        return int(self._c_skew.value)
+
+    @property
+    def ejections_total(self) -> int:
+        return int(self._c_ejections.value)
+
+    @property
+    def readmissions_total(self) -> int:
+        return int(self._c_readmissions.value)
+
+    @property
+    def no_capacity_total(self) -> int:
+        return int(self._c_no_capacity.value)
 
     # -- health -------------------------------------------------------------
     def _get_json(self, url: str, timeout: float = 5.0) -> dict:
@@ -203,7 +236,9 @@ class Router:
         with self._lock:
             if ok:
                 if not m.healthy:
-                    self.readmissions_total += 1
+                    self._c_readmissions.inc()
+                    obs_flight.record("member_readmitted", group=group,
+                                      url=m.url)
                 m.healthy, m.fails, m.doc = True, 0, doc
                 if "group_generation" in doc:
                     self._generation[group] = int(doc["group_generation"])
@@ -211,7 +246,10 @@ class Router:
                 m.fails += 1
                 if m.healthy and m.fails >= self._eject_after:
                     m.healthy = False
-                    self.ejections_total += 1
+                    self._c_ejections.inc()
+                    obs_flight.record("member_ejected", group=group,
+                                      url=m.url, via="probe",
+                                      fails=m.fails)
 
     def probe_once(self) -> None:
         for g, members in self._members.items():
@@ -285,11 +323,14 @@ class Router:
         key = self.request_key(body)
         rows = len(body.get("instances", []))
         plan = self._plan(key)
-        with self._lock:
-            self.requests_total += 1
+        self._c_requests.inc()
+        # the request's trace context (set by the router handler): every
+        # forward attempt becomes a span, and the SAME trace id rides the
+        # propagation headers across retries — including the 409 re-pin
+        # path, so one client request is one trace end-to-end
+        tctx = current_trace()
         if not plan:
-            with self._lock:
-                self.no_capacity_total += 1
+            self._c_no_capacity.inc()
             return 503, {"error": "no healthy shard-group"}
         payload = json.dumps(body).encode()
         attempts = 0
@@ -306,12 +347,13 @@ class Router:
             for pin_attempt in range(2):
                 attempts += 1
                 if attempts > 1:
-                    with self._lock:
-                        self.retries_total += 1
+                    self._c_retries.inc()
                 gen = self._generation.get(group)
                 headers = {"Content-Type": "application/json"}
                 if gen is not None:
                     headers["X-Pinned-Generation"] = str(gen)
+                if tctx is not None:
+                    headers.update(tctx.headers())
                 req = urllib.request.Request(
                     f"{m.url}{target}", data=payload, headers=headers,
                 )
@@ -323,15 +365,18 @@ class Router:
                         req, timeout=self._timeout
                     ) as r:
                         doc = json.load(r)
+                    self._windows[group].observe(time.perf_counter() - t0)
+                    self._group_requests[group].inc()
                     with self._lock:
-                        self._windows[group].record(
-                            time.perf_counter() - t0
-                        )
-                        self._group_requests[group] += 1
                         if "group_generation" in doc:
                             self._generation[group] = int(
                                 doc["group_generation"]
                             )
+                    if tctx is not None:
+                        tctx.add_span(
+                            "router.forward", t0, time.perf_counter(),
+                            group=group, attempt=attempts, status=200,
+                        )
                     doc["router"] = {"group": group, "attempts": attempts}
                     return 200, doc
                 except urllib.error.HTTPError as e:
@@ -339,11 +384,16 @@ class Router:
                         err = json.load(e)
                     except (ValueError, OSError):
                         err = {"error": f"http {e.code}"}
+                    if tctx is not None:
+                        tctx.add_span(
+                            "router.forward", t0, time.perf_counter(),
+                            group=group, attempt=attempts, status=e.code,
+                        )
                     if e.code == 409:
                         # generation skew: learn the member's live
                         # generation and retry once, same group
+                        self._c_skew.inc()
                         with self._lock:
-                            self.skew_aborts_total += 1
                             if "group_generation" in err:
                                 self._generation[group] = int(
                                     err["group_generation"]
@@ -362,27 +412,36 @@ class Router:
                         # is the engine's BACKPRESSURE signal (bounded
                         # queue shedding), and ejecting an overloaded-
                         # but-healthy member would amplify the overload
-                        with self._lock:
-                            m.fails += 1
-                            if m.healthy and m.fails >= self._eject_after:
-                                m.healthy = False
-                                self.ejections_total += 1
+                        self._eject_on_traffic(group, m, f"http {e.code}")
                     break  # 5xx/503: next group
                 except Exception as e:
                     # connection-level failure: count toward ejection so
                     # a dead member leaves rotation at traffic speed, not
                     # probe speed
-                    with self._lock:
-                        m.fails += 1
-                        if m.healthy and m.fails >= self._eject_after:
-                            m.healthy = False
-                            self.ejections_total += 1
+                    if tctx is not None:
+                        tctx.add_span(
+                            "router.forward", t0, time.perf_counter(),
+                            group=group, attempt=attempts,
+                            status=type(e).__name__,
+                        )
+                    self._eject_on_traffic(group, m, type(e).__name__)
                     last_err = {"error": f"{type(e).__name__}: {e}"}
                     break
                 finally:
                     with self._lock:
                         m.inflight -= rows
         return 503, last_err
+
+    def _eject_on_traffic(self, group: str, m: _Member, why: str) -> None:
+        with self._lock:
+            m.fails += 1
+            ejected = m.healthy and m.fails >= self._eject_after
+            if ejected:
+                m.healthy = False
+        if ejected:
+            self._c_ejections.inc()
+            obs_flight.record("member_ejected", group=group, url=m.url,
+                              via="traffic", reason=why)
 
     # -- observability ------------------------------------------------------
     def metrics_snapshot(self) -> dict:
@@ -396,7 +455,7 @@ class Router:
                     "healthy_members": len(healthy),
                     "inflight_rows": sum(m.inflight for m in members),
                     "generation": self._generation.get(g),
-                    "requests_total": self._group_requests[g],
+                    "requests_total": int(self._group_requests[g].value),
                     "latency_ms": self._windows[g].snapshot(),
                     "exchange_wire_bytes_est": doc.get(
                         "exchange_wire_bytes_est"
@@ -429,10 +488,17 @@ def make_router_handler(router: Router):
         protocol_version = "HTTP/1.1"
         disable_nagle_algorithm = True
         _send = _send_json
+        _send_plain = _send_text
 
         def do_GET(self):  # noqa: N802
             if self.path == "/healthz":
                 self._send(200, {"status": "alive", "role": "router"})
+            elif self.path == "/metrics":
+                self._send_plain(200, router.registry.render_prometheus())
+            elif self.path == "/v1/trace/recent":
+                self._send(200, {"traces": router.tracer.recent()})
+            elif self.path == "/v1/flight":
+                self._send(200, {"events": obs_flight.render_events()})
             elif self.path == "/readyz":
                 snap = router.metrics_snapshot()
                 ready = any(
@@ -456,19 +522,30 @@ def make_router_handler(router: Router):
             if self.path not in (predict_path, recommend_path):
                 return self._send(404,
                                   {"error": f"unknown path {self.path!r}"})
+            # the trace head: mint an X-Trace-Id (or adopt the client's)
+            # here, where the request enters the pool; handle_predict
+            # propagates it to the member on every attempt
+            name = ("recommend" if self.path == recommend_path
+                    else "predict")
+            ctx = router.tracer.begin(name, self.headers)
+            token = router.tracer.activate(ctx)
+            self._obs_status = None
             try:
-                length = int(self.headers.get("Content-Length", "0"))
-                body = json.loads(self.rfile.read(length))
-                body["instances"]
-            except Exception as e:
-                return self._send(400,
-                                  {"error": f"{type(e).__name__}: {e}"})
-            code, doc = router.handle_predict(
-                body,
-                path=recommend_path if self.path == recommend_path
-                else None,
-            )
-            self._send(code, doc)
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(length))
+                    body["instances"]
+                except Exception as e:
+                    return self._send(400,
+                                      {"error": f"{type(e).__name__}: {e}"})
+                code, doc = router.handle_predict(
+                    body,
+                    path=recommend_path if self.path == recommend_path
+                    else None,
+                )
+                self._send(code, doc)
+            finally:
+                router.tracer.finish(ctx, token, status=self._obs_status)
 
         def log_message(self, fmt, *args):
             pass

@@ -41,6 +41,9 @@ from typing import Callable
 
 import numpy as np
 
+from ...obs import flight as obs_flight
+from ...obs.metrics import MetricsRegistry
+from ...obs.trace import DEFAULT_SAMPLE_RATE, Tracer
 from ..batcher import MicroBatcher
 from ..server import ScoringHTTPServer, make_handler
 from .sharded import group_wire_bytes_est, load_sharded_servable
@@ -92,10 +95,19 @@ class GroupMember:
         funnel_top_k: int = 0,
         funnel_return_n: int = 0,
         precompile: bool = True,
+        registry: MetricsRegistry | None = None,
     ):
         from ...funnel.publish import is_funnel_servable
         from ...parallel.mesh import mesh_shape
 
+        # one obs registry + trace tail per member process: the engine
+        # renders into it and the handler serves GET /metrics from it
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        # router-propagated trace ids are always recorded (the head
+        # decided); only direct member traffic is sampled locally
+        self.tracer = Tracer(f"worker:{group}/{member}",
+                             sample_rate=DEFAULT_SAMPLE_RATE)
         self.funnel = is_funnel_servable(os.path.abspath(servable_dir))
         if self.funnel:
             # a funnel member serves /v1/recommend: the retrieval index
@@ -109,6 +121,7 @@ class GroupMember:
                 return_n=funnel_return_n, buckets=buckets,
                 max_wait_ms=max_wait_ms, max_queue_rows=max_queue_rows,
                 precompile=False, name=f"recommend[{group}/{member}]",
+                registry=self.registry,
             )
             ctx = self._scorer.ctx
             holder = self._scorer.holder
@@ -150,6 +163,7 @@ class GroupMember:
                 predict, ctx.cfg.model.field_size, buckets=buckets,
                 max_wait_ms=max_wait_ms, max_queue_rows=max_queue_rows,
                 name=f"predict[{group}/{member}]",
+                registry=self.registry,
             )
             self._canary = _canary_batch(ctx.cfg, int(sorted(buckets)[0]))
         self._lock = threading.Lock()
@@ -250,12 +264,23 @@ class GroupMember:
                 payload, manifest = self._scorer.stage_version(
                     root, int(version), self._staging
                 )
-            except Exception:
+            except Exception as e:
                 with self._lock:
                     self.stage_failures_total += 1
+                obs_flight.record(
+                    "swap_stage_failed", subsystem="pool",
+                    group=self.group, member=self.member,
+                    version=int(version),
+                    error=f"{type(e).__name__}: {e}",
+                )
                 raise
             with self._lock:
                 self._staged = (payload, manifest)
+            obs_flight.record(
+                "swap_stage", subsystem="pool", group=self.group,
+                member=self.member, version=manifest.version,
+            )
+            with self._lock:
                 return {"staged_version": manifest.version,
                         "group_generation": self.generation}
         try:
@@ -293,12 +318,22 @@ class GroupMember:
                 raise ValueError(
                     "canary probe produced out-of-range scores"
                 )
-        except Exception:
+        except Exception as e:
             with self._lock:
                 self.stage_failures_total += 1
+            obs_flight.record(
+                "swap_stage_failed", subsystem="pool", group=self.group,
+                member=self.member, version=int(version),
+                error=f"{type(e).__name__}: {e}",
+            )
             raise
         with self._lock:
             self._staged = (payload, manifest)
+        obs_flight.record(
+            "swap_stage", subsystem="pool", group=self.group,
+            member=self.member, version=manifest.version,
+        )
+        with self._lock:
             return {"staged_version": manifest.version,
                     "group_generation": self.generation}
 
@@ -346,6 +381,11 @@ class GroupMember:
             self._prev = prev
             self._staged = None
             self.swaps_total += 1
+            obs_flight.record(
+                "swap_commit", subsystem="pool", group=self.group,
+                member=self.member, generation=self.generation,
+                version=self._holder.version, drained=bool(drained),
+            )
             return {"group_generation": self.generation,
                     "model_version": self._holder.version,
                     "drained": bool(drained)}
@@ -365,6 +405,10 @@ class GroupMember:
             self._holder.swap(payload, version=ver, manifest=manifest)
             self._prev = None
             self.rollbacks_total += 1
+            obs_flight.record(
+                "swap_rollback", subsystem="pool", group=self.group,
+                member=self.member, generation=gen, version=ver,
+            )
             return {"group_generation": self.generation,
                     "model_version": self._holder.version}
 
@@ -372,7 +416,12 @@ class GroupMember:
         with self._lock:
             had = self._staged is not None
             self._staged = None
-            return {"aborted": had, "group_generation": self.generation}
+            gen = self.generation
+        if had:
+            obs_flight.record("swap_abort", subsystem="pool",
+                              group=self.group, member=self.member,
+                              generation=gen)
+        return {"aborted": had, "group_generation": gen}
 
     def close(self) -> None:
         self.engine.close()
@@ -387,6 +436,8 @@ def make_member_handler(member: GroupMember, model_name: str):
         reload_status=member.reload_status,
         readiness=member.readiness,
         group_status=member.group_status,
+        registry=member.registry,
+        tracer=member.tracer,
     )
     predict_paths = {
         f"/v1/models/{model_name}:predict",
@@ -428,6 +479,12 @@ def make_member_handler(member: GroupMember, model_name: str):
                         # the skew abort: refuse, never score — the
                         # router re-pins and retries
                         member.skew_aborts_total += 1
+                        obs_flight.record(
+                            "skew_abort", subsystem="pool",
+                            group=member.group, member=member.member,
+                            pinned_generation=want,
+                            group_generation=member.generation,
+                        )
                         self._drain_body()
                         return self._send(409, {
                             "error": "generation skew",
@@ -437,7 +494,17 @@ def make_member_handler(member: GroupMember, model_name: str):
                         })
                 if (getattr(member, "funnel", False)
                         and self.path == "/v1/recommend"):
-                    return self._do_recommend()
+                    # recommend rides the same trace tail as predict:
+                    # adopt the router-propagated X-Trace-Id (or the
+                    # client's) so the funnel spans join the one trace
+                    ctx = member.tracer.begin("recommend", self.headers)
+                    token = member.tracer.activate(ctx)
+                    self._obs_status = None
+                    try:
+                        return self._do_recommend()
+                    finally:
+                        member.tracer.finish(ctx, token,
+                                             status=self._obs_status)
             return super().do_POST()
 
         def _do_recommend(self):

@@ -44,6 +44,8 @@ import numpy as np
 
 from ..core.config import Config
 from ..models.base import get_model
+from ..obs import flight as obs_flight
+from ..obs.metrics import MetricsRegistry
 from ..online.publisher import (
     fetch_version,
     latest_manifest,
@@ -210,6 +212,7 @@ class HotSwapper:
         staging_dir: str | None = None,
         drain_timeout_secs: float = 30.0,
         breaker: CircuitBreaker | None = None,
+        registry: MetricsRegistry | None = None,
     ):
         self._holder = holder
         self._predict_with = predict_with
@@ -241,13 +244,52 @@ class HotSwapper:
             failure_threshold=0.5, window=6, min_calls=3,
             cooldown_secs=max(5.0, 4.0 * self._interval), name="reload",
         )
-        self.swaps_total = 0
-        self.rollbacks_total = 0
-        self.poll_errors_total = 0
-        self.polls_skipped_total = 0
+        # counters live in the obs registry (labels make the reload
+        # section scrape-able); status() re-renders the pinned JSON
+        # schema from the same values
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        events = self.registry.counter(
+            "deepfm_reload_events_total",
+            "hot-reload lifecycle events by kind", labels=("event",))
+        self._c_swaps = events.labels("swap")
+        self._c_rollbacks = events.labels("rollback")
+        self._c_poll_errors = events.labels("poll_error")
+        self._c_polls_skipped = events.labels("poll_skipped")
+        self._g_version = self.registry.gauge(
+            "deepfm_reload_model_version", "live served model version")
+        self._g_staleness = self.registry.gauge(
+            "deepfm_reload_weight_staleness_seconds",
+            "now minus the live manifest's publish time")
+        self.registry.on_collect(self._refresh_gauges)
         self.last_swap_ms: float | None = None
         self.last_check_unix: float | None = None
         self.last_error: str | None = None
+
+    # registry-backed totals (read-compatible with the pre-registry attrs)
+    @property
+    def swaps_total(self) -> int:
+        return int(self._c_swaps.value)
+
+    @property
+    def rollbacks_total(self) -> int:
+        return int(self._c_rollbacks.value)
+
+    @property
+    def poll_errors_total(self) -> int:
+        return int(self._c_poll_errors.value)
+
+    @property
+    def polls_skipped_total(self) -> int:
+        return int(self._c_polls_skipped.value)
+
+    def _refresh_gauges(self) -> None:
+        self._g_version.set(self._holder.version)
+        manifest = self._holder.manifest
+        if manifest is not None:
+            self._g_staleness.set(
+                max(0.0, time.time() - manifest.created_unix)
+            )
 
     # -- one poll/swap cycle ------------------------------------------------
     def poll_once(self) -> bool:
@@ -264,15 +306,14 @@ class HotSwapper:
         with self._lock:
             self.last_check_unix = time.time()
         if not self._breaker.allow():
-            with self._lock:
-                self.polls_skipped_total += 1
+            self._c_polls_skipped.inc()
             return False
         try:
             manifest = latest_manifest(self._source)
         except Exception as e:
             self._breaker.record_failure()
+            self._c_poll_errors.inc()
             with self._lock:
-                self.poll_errors_total += 1
                 self.last_error = f"poll: {type(e).__name__}: {e}"
             return False
         if manifest is None or manifest.version <= self._holder.version:
@@ -286,8 +327,8 @@ class HotSwapper:
             # store-facing fetch: an outage here is a poll error + breaker
             # food, NOT a rollback — nothing was ever a swap candidate
             self._breaker.record_failure()
+            self._c_poll_errors.inc()
             with self._lock:
-                self.poll_errors_total += 1
                 self.last_error = f"stage: {type(e).__name__}: {e}"
             return False
         self._breaker.record_success()
@@ -299,19 +340,28 @@ class HotSwapper:
                 payload, version=manifest.version, manifest=manifest,
                 drain_timeout_secs=self._drain_timeout,
             )
+            self._c_swaps.inc()
             with self._lock:
                 self.last_swap_ms = round(
                     1e3 * (time.perf_counter() - t0), 3
                 )
-                self.swaps_total += 1
                 self.last_error = (
                     None if drained else "drain timeout (swap still applied)"
                 )
+            obs_flight.record(
+                "swap_commit", subsystem="reload",
+                version=manifest.version, drained=bool(drained),
+            )
             return True
         except Exception as e:
+            self._c_rollbacks.inc()
             with self._lock:
-                self.rollbacks_total += 1
                 self.last_error = f"{type(e).__name__}: {e}"
+            obs_flight.record(
+                "swap_rollback", subsystem="reload",
+                version=manifest.version,
+                error=f"{type(e).__name__}: {e}",
+            )
             return False
 
     def _purge_staged(self, local: str) -> None:

@@ -48,6 +48,14 @@ from typing import Callable
 
 import numpy as np
 
+from ..obs import flight as obs_flight
+from ..obs.metrics import MetricsRegistry
+from ..obs.trace import (
+    DEFAULT_SAMPLE_RATE,
+    TRACE_HEADER,
+    Tracer,
+    current_trace,
+)
 from .batcher import (
     MicroBatcher,
     OverloadedError,
@@ -131,17 +139,21 @@ class RetrievalScorer:
 
     def __init__(self, encode_user: Callable, encode_item: Callable,
                  cfg, buckets=(8, 32, 128, 512), max_wait_ms: float = 2.0,
-                 max_queue_rows: int | None = None):
+                 max_queue_rows: int | None = None, registry=None):
+        # one registry, two engines: the families are labeled by engine
+        # name, so GET /metrics shows both towers side by side
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
         self._batchers = {
             "user": MicroBatcher(
                 encode_user, cfg.model.user_field_size, buckets=buckets,
                 max_wait_ms=max_wait_ms, max_queue_rows=max_queue_rows,
-                name="encode_user",
+                name="encode_user", registry=self.registry,
             ),
             "item": MicroBatcher(
                 encode_item, cfg.model.item_field_size, buckets=buckets,
                 max_wait_ms=max_wait_ms, max_queue_rows=max_queue_rows,
-                name="encode_item",
+                name="encode_item", registry=self.registry,
             ),
         }
         self._corpus_ids: np.ndarray | None = None
@@ -201,17 +213,28 @@ class RetrievalScorer:
         return self._corpus_ids[top], scores[row, top]
 
 
-def make_retrieval_handler(scorer: RetrievalScorer, model_name: str):
+def make_retrieval_handler(scorer: RetrievalScorer, model_name: str,
+                           tracer=None):
     base = f"/v1/models/{model_name}"
+    tracer = tracer if tracer is not None else Tracer(
+        model_name, sample_rate=DEFAULT_SAMPLE_RATE)
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"  # keep-alive (Content-Length always sent)
         disable_nagle_algorithm = True  # no Nagle+delayed-ACK stalls
         _send = _send_json
+        _send_plain = _send_text
+        obs_tracer = tracer
 
         def do_GET(self):  # noqa: N802
             if self.path == "/healthz":
                 self._send(200, {"status": "alive"})
+            elif self.path == "/metrics":
+                self._send_plain(200, scorer.registry.render_prometheus())
+            elif self.path == "/v1/trace/recent":
+                self._send(200, {"traces": tracer.recent()})
+            elif self.path == "/v1/flight":
+                self._send(200, {"events": obs_flight.render_events()})
             elif self.path == "/readyz":
                 # retrieval servables have no reload path: ready once the
                 # engines precompiled (which happened before the socket
@@ -244,6 +267,17 @@ def make_retrieval_handler(scorer: RetrievalScorer, model_name: str):
                 f"{base}:encode_user", f"{base}:encode_item",
                 f"{base}:retrieve",
             }
+            traced = self.path in known
+            ctx = (tracer.begin(self.path.rsplit(":", 1)[-1], self.headers)
+                   if traced else None)
+            token = tracer.activate(ctx)
+            self._obs_status = None
+            try:
+                self._handle_post(known)
+            finally:
+                tracer.finish(ctx, token, status=self._obs_status)
+
+        def _handle_post(self, known):
             if self.path not in known:
                 self._send(404, {"error": f"unknown path {self.path!r}"})
                 return
@@ -316,12 +350,30 @@ def _send_json(self, code: int, payload: dict) -> None:
     # which process answered — lets pool clients/ops attribute responses
     # (and lets the bench warm every SO_REUSEPORT worker deterministically)
     self.send_header("X-Serving-Pid", str(os.getpid()))
+    ctx = current_trace()
+    if ctx is not None:
+        # every traced response carries its trace id, success or error —
+        # the client's correlation handle into /v1/trace/recent
+        self.send_header(TRACE_HEADER, ctx.trace_id)
     self.end_headers()
     self.wfile.write(body)
+    # observed by the tracing wrapper (finish() stamps it as the status)
+    self._obs_status = code
+
+
+def _send_text(self, code: int, body: str,
+               content_type: str = "text/plain; version=0.0.4") -> None:
+    raw = body.encode()
+    self.send_response(code)
+    self.send_header("Content-Type", content_type)
+    self.send_header("Content-Length", str(len(raw)))
+    self.end_headers()
+    self.wfile.write(raw)
 
 
 def make_handler(scorer, model_name: str, reload_status=None,
-                 readiness=None, group_status=None):
+                 readiness=None, group_status=None, registry=None,
+                 tracer=None):
     """REST handler over any engine exposing score/score_instances —
     the micro-batching engine in production; the single-lock Scorer only
     in the benchmark baseline.  ``GET /v1/metrics`` serves the engine's
@@ -359,10 +411,22 @@ def make_handler(scorer, model_name: str, reload_status=None,
     weight supply is broken out before it serves stale scores silently);
     ``readiness`` is a zero-arg callable returning the readiness doc with
     a boolean ``ready`` key (default: ready once the handler exists, which
-    is after precompile)."""
+    is after precompile).
+
+    Observability surfaces (obs/): ``GET /metrics`` renders ``registry``
+    (default: the scorer's own) in Prometheus text exposition format;
+    ``GET /v1/trace/recent`` serves the bounded recent-traces ring;
+    ``GET /v1/flight`` serves the process flight-recorder ring.  Predict
+    requests are traced through ``tracer`` (accepting a client-supplied
+    ``X-Trace-Id``/``X-Span-Id`` pair, else head-sampling) and every
+    traced response carries ``X-Trace-Id``."""
     predict_path = f"/v1/models/{model_name}:predict"
     binary_path = f"/v1/models/{model_name}:predict_binary"
     status_path = f"/v1/models/{model_name}"
+    registry = registry if registry is not None \
+        else getattr(scorer, "registry", None)
+    tracer = tracer if tracer is not None else Tracer(
+        model_name, sample_rate=DEFAULT_SAMPLE_RATE)
 
     class Handler(BaseHTTPRequestHandler):
         # HTTP/1.1 keep-alive: every response carries Content-Length, so
@@ -374,10 +438,18 @@ def make_handler(scorer, model_name: str, reload_status=None,
         protocol_version = "HTTP/1.1"
         disable_nagle_algorithm = True
         _send = _send_json
+        _send_plain = _send_text
+        obs_tracer = tracer          # member handlers reuse the same head
 
         def do_GET(self):  # noqa: N802 (http.server API)
             if self.path == "/healthz":
                 self._send(200, {"status": "alive"})
+            elif self.path == "/metrics" and registry is not None:
+                self._send_plain(200, registry.render_prometheus())
+            elif self.path == "/v1/trace/recent":
+                self._send(200, {"traces": tracer.recent()})
+            elif self.path == "/v1/flight":
+                self._send(200, {"events": obs_flight.render_events()})
             elif self.path == "/readyz":
                 doc = (readiness() if readiness is not None
                        else {"ready": True, "engine_compiled": True,
@@ -421,6 +493,17 @@ def make_handler(scorer, model_name: str, reload_status=None,
                 self._send(404, {"error": f"unknown path {self.path!r}"})
 
         def do_POST(self):  # noqa: N802
+            traced = self.path in (predict_path, binary_path)
+            ctx = (tracer.begin(self.path.rsplit(":", 1)[-1], self.headers)
+                   if traced else None)
+            token = tracer.activate(ctx)
+            self._obs_status = None
+            try:
+                self._handle_post()
+            finally:
+                tracer.finish(ctx, token, status=self._obs_status)
+
+        def _handle_post(self):
             if self.path == binary_path:
                 self._predict_binary()
                 return
@@ -513,6 +596,10 @@ def make_handler(scorer, model_name: str, reload_status=None,
             self.send_header("Content-Type", "application/octet-stream")
             self.send_header("Content-Length", str(len(body)))
             self.send_header("X-Serving-Pid", str(_os.getpid()))
+            ctx = current_trace()
+            if ctx is not None:
+                self.send_header(TRACE_HEADER, ctx.trace_id)
+            self._obs_status = 200
             if group_status is not None:
                 gs = group_status()
                 self.send_header("X-Shard-Group", str(gs.get("shard_group")))
@@ -679,6 +766,8 @@ def serve_forever(
     reload_url: str | None = None, reload_interval_secs: float = 2.0,
     funnel_top_k: int = 0, funnel_return_n: int = 0,
     funnel_data_parallel: int = 1, funnel_model_parallel: int = 0,
+    trace_sample_rate: float = DEFAULT_SAMPLE_RATE,
+    trace_export: str | None = None,
     ready: threading.Event | None = None,
 ) -> None:
     """Serve whichever servable lives at ``servable_dir``: CTR models get
@@ -721,6 +810,8 @@ def serve_forever(
             top_k=funnel_top_k, return_n=funnel_return_n,
             data_parallel=funnel_data_parallel,
             model_parallel=funnel_model_parallel,
+            trace_sample_rate=trace_sample_rate,
+            trace_export=trace_export,
             ready=ready,
         )
         return
@@ -730,17 +821,26 @@ def serve_forever(
             "--reload-url supports CTR servables only (two-tower serving "
             "has no hot-swap path yet)"
         )
+    # ONE observability registry + trace head per serving process: the
+    # engine, the hot swapper and the handler all render into it, so
+    # GET /metrics is the process's full picture.  Fresh requests are
+    # head-sampled at the shipped default; propagated X-Trace-Ids are
+    # always recorded (obs/trace.py DEFAULT_SAMPLE_RATE).
+    registry = MetricsRegistry()
+    tracer = Tracer("server", sample_rate=trace_sample_rate,
+                    export_path=trace_export)
     if cfg.model.model_name == "two_tower":
         encode_user, encode_item, cfg = load_retrieval_servable(servable_dir)
         rscorer = RetrievalScorer(
             encode_user, encode_item, cfg, buckets=buckets,
             max_wait_ms=max_wait_ms, max_queue_rows=max_queue_rows,
+            registry=registry,
         )
         compiles = rscorer.precompile()
         if item_corpus:
             n = rscorer.load_corpus(item_corpus)
             print(f"encoded item corpus: {n} items", file=sys.stderr)
-        handler = make_retrieval_handler(rscorer, model_name)
+        handler = make_retrieval_handler(rscorer, model_name, tracer=tracer)
         endpoint = "encode_user|encode_item|retrieve"
     else:
         if item_corpus:
@@ -757,7 +857,7 @@ def serve_forever(
             )
             swapper = HotSwapper(
                 holder, predict_with, reload_url, cfg,
-                interval_secs=reload_interval_secs,
+                interval_secs=reload_interval_secs, registry=registry,
             )
             # adopt any already-published version BEFORE the socket opens,
             # then poll in the background
@@ -769,6 +869,7 @@ def serve_forever(
         scorer = MicroBatcher(
             predict, cfg.model.field_size, buckets=buckets,
             max_wait_ms=max_wait_ms, max_queue_rows=max_queue_rows,
+            registry=registry,
         )
         compiles = scorer.precompile()
 
@@ -789,7 +890,8 @@ def serve_forever(
 
         handler = make_handler(scorer, model_name,
                                reload_status=reload_status,
-                               readiness=readiness)
+                               readiness=readiness,
+                               registry=registry, tracer=tracer)
         endpoint = "predict"
     print(f"precompiled bucket executables: {compiles}", file=sys.stderr)
     httpd = ScoringHTTPServer((host, port), handler)
@@ -945,7 +1047,30 @@ def main(argv: list[str] | None = None) -> int:
         help="funnel mesh: index row-shard factor "
              "(0 = remaining devices / funnel-dp)",
     )
+    ap.add_argument(
+        "--trace-sample", type=float, default=DEFAULT_SAMPLE_RATE,
+        help="head-based trace sampling rate for FRESH requests "
+             "(propagated/client-supplied X-Trace-Ids are always "
+             "recorded); 0 disables minting, 1 traces everything",
+    )
+    ap.add_argument(
+        "--trace-export", default=None,
+        help="optional JSONL file to append every finished trace to "
+             "(offline correlation with the flight recorder)",
+    )
+    ap.add_argument(
+        "--flight-dump", default=None,
+        help="arm the flight-recorder termination dump: the event ring "
+             "is written here as JSONL when SIGTERM lands or the process "
+             "crashes (obs/flight.py; the live ring is always at "
+             "GET /v1/flight)",
+    )
     args = ap.parse_args(argv)
+    if args.flight_dump:
+        obs_flight.install(args.flight_dump)
+        # no PreemptionGuard in a serve process — route SIGTERM through
+        # the dump, then re-deliver with the default action (terminate)
+        obs_flight.dump_on_signal()
     if args.stdin:
         score_stdin(args.servable, batch_size=args.batch_size,
                     buckets=args.buckets)
@@ -976,6 +1101,8 @@ def main(argv: list[str] | None = None) -> int:
         funnel_return_n=args.funnel_return_n,
         funnel_data_parallel=args.funnel_dp,
         funnel_model_parallel=args.funnel_mp,
+        trace_sample_rate=args.trace_sample,
+        trace_export=args.trace_export,
     )
     return 0
 

@@ -30,6 +30,8 @@ import threading
 
 import numpy as np
 
+from ..obs import flight as obs_flight
+from ..obs.metrics import MetricsRegistry
 from .host import HostTier
 from .store import RecordLayout
 
@@ -134,6 +136,7 @@ class DevicePager:
         stage_rows: int,
         readback_fn,
         vocab: int,
+        registry: MetricsRegistry | None = None,
     ):
         if stage_rows < 1:
             raise ValueError("stage_rows must be >= 1")
@@ -149,10 +152,32 @@ class DevicePager:
         # double-buffered staging: [2][stage_slots + per-table packs]
         self._bufs = [self._new_stage_buf() for _ in range(2)]
         self._buf_ix = 0
+        # counters live in the obs registry (one labeled family per
+        # unit), so the paging section scrapes via GET /metrics with
+        # labels; stats() re-renders the pinned snapshot dict from the
+        # same values
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        events = self.registry.counter(
+            "deepfm_paging_events_total",
+            "pager lifecycle events by kind", labels=("event",))
+        rows = self.registry.counter(
+            "deepfm_paging_rows_total",
+            "rows moved between tiers", labels=("kind",))
+        byts = self.registry.counter(
+            "deepfm_paging_bytes_total",
+            "bytes moved between tiers", labels=("kind",))
         self._stats = {
-            "probe_ids": 0, "probe_unique": 0, "hits": 0, "misses": 0,
-            "evictions": 0, "writeback_rows": 0, "staged_rows": 0,
-            "stage_bytes": 0, "writeback_bytes": 0, "steps": 0,
+            "probe_ids": events.labels("probe_ids"),
+            "probe_unique": events.labels("probe_unique"),
+            "hits": events.labels("hit"),
+            "misses": events.labels("miss"),
+            "evictions": events.labels("eviction"),
+            "writeback_rows": rows.labels("writeback"),
+            "staged_rows": rows.labels("staged"),
+            "stage_bytes": byts.labels("stage"),
+            "writeback_bytes": byts.labels("writeback"),
+            "steps": events.labels("step"),
         }
 
     def _new_stage_buf(self) -> dict:
@@ -188,15 +213,19 @@ class DevicePager:
         np.clip(ids, 0, self.vocab - 1, out=ids)
         uniq, inv = np.unique(ids, return_inverse=True)
         self._map.begin()
-        self._stats["steps"] += 1
-        self._stats["probe_ids"] += int(ids.size)
-        self._stats["probe_unique"] += int(uniq.size)
+        self._stats["steps"].inc()
+        self._stats["probe_ids"].inc(int(ids.size))
+        self._stats["probe_unique"].inc(int(uniq.size))
 
         slots, miss_ix = self._map.probe(uniq)
         n_miss = len(miss_ix)
-        self._stats["hits"] += int(uniq.size) - n_miss
-        self._stats["misses"] += n_miss
+        self._stats["hits"].inc(int(uniq.size) - n_miss)
+        self._stats["misses"].inc(n_miss)
         if n_miss > self.stage_rows:
+            # a paging stall severe enough to refuse the step is an
+            # incident landmark — one line in the flight timeline
+            obs_flight.record("paging_stage_overflow", subsystem="tiered",
+                              misses=n_miss, stage_rows=self.stage_rows)
             raise ValueError(
                 f"batch needs {n_miss} staged rows > stage capacity "
                 f"{self.stage_rows}; raise tiered_stage_rows"
@@ -219,10 +248,8 @@ class DevicePager:
                 buf["stage"][k]["v"][:n_miss] = np.asarray(v[k])[order]
             self._map.assign(victims, miss_rows)
             slots[miss_ix] = victims
-            self._stats["staged_rows"] += n_miss
-            self._stats["stage_bytes"] += (
-                n_miss * self.layout.width * 4
-            )
+            self._stats["staged_rows"].inc(n_miss)
+            self._stats["stage_bytes"].inc(n_miss * self.layout.width * 4)
         # padding: distinct ascending out-of-range sentinels (dropped by
         # mode="drop", keep the index vector sorted AND unique)
         pad = np.arange(self.capacity, self.capacity
@@ -247,7 +274,7 @@ class DevicePager:
             if dirty.size:
                 self._writeback(dirty, hot)
             self._map.release(victims)
-            self._stats["evictions"] += int(victims.size)
+            self._stats["evictions"].inc(int(victims.size))
         return take
 
     def _writeback(self, slots: np.ndarray, hot) -> None:
@@ -264,8 +291,8 @@ class DevicePager:
                 {k: np.asarray(v_d[k])[:q] for k in self.layout.keys},
             )
             self.host.put_records(self._map.slot_row[chunk], recs)
-            self._stats["writeback_rows"] += q
-            self._stats["writeback_bytes"] += q * self.layout.width * 4
+            self._stats["writeback_rows"].inc(q)
+            self._stats["writeback_bytes"].inc(q * self.layout.width * 4)
         self._slot_dirty[slots] = False
 
     # -- checkpoint / publish barrier --------------------------------------
@@ -279,7 +306,9 @@ class DevicePager:
             )
             if dirty.size:
                 self._writeback(dirty, hot)
-            return int(dirty.size)
+        obs_flight.record("paging_flush", subsystem="tiered",
+                          rows=int(dirty.size))
+        return int(dirty.size)
 
     def drop_clean(self) -> None:
         """Forget every (now-clean) mapping — crash-resume starts cache
@@ -291,8 +320,7 @@ class DevicePager:
             self._map.reset()
 
     def stats(self) -> dict:
-        with self._lock:
-            out = dict(self._stats)
+        out = {k: int(c.value) for k, c in self._stats.items()}
         probed = max(1, out["probe_unique"])
         out["hit_rate"] = round(out["hits"] / probed, 6)
         return out

@@ -44,6 +44,7 @@ from ..parallel import (
     shard_batch,
     shard_batch_stacked,
 )
+from ..obs.trace import StepPhases
 from ..serve import export_servable, write_predictions
 from ..train.step import TrainState
 from ..utils import MetricLogger
@@ -408,11 +409,18 @@ def _run_train_guarded(cfg: Config, guard: PreemptionGuard) -> TrainState:
     lr_sched = build_lr_schedule(
         ctx.cfg.optimizer, data_parallel_size=ctx.cfg.mesh.data_parallel
     )
-    lr_extra = (
-        (lambda: {"lr": float(schedule_value(lr_sched, max(0, step - 1)))})
-        if callable(lr_sched)
-        else None
-    )
+    # step-phase spans (obs/trace.py): where each logged window's host
+    # time went — input-pipeline wait vs host bookkeeping vs device
+    # dispatch — attributable from the metrics line alone, no profiler.
+    # Evaluated only on emitting calls (MetricLogger.step `extra`), like
+    # the scheduled lr below.
+    phases = StepPhases()
+
+    def lr_extra():
+        out = phases.snapshot_ms()
+        if callable(lr_sched):
+            out["lr"] = float(schedule_value(lr_sched, max(0, step - 1)))
+        return out
     # periodic in-training eval, the train_and_evaluate cadence (ps:510-520):
     # no eval before start_delay, then at most one per throttle interval.
     # 0/0 (default) means end-of-training eval only — the reference's values
@@ -429,8 +437,15 @@ def _run_train_guarded(cfg: Config, guard: PreemptionGuard) -> TrainState:
         if not guard.should_stop
         else contextlib.nullcontext(())
     )
+    _END = object()
     with profile_cm, feed_cm as batches:
-        for item in batches:
+        it = iter(batches)
+        while True:
+            # data_wait: time blocked on the input pipeline's next item
+            with phases.phase("data_wait"):
+                item = next(it, _END)
+            if item is _END:
+                break
             if steps_per_loop > 1:
                 tag, batch = item
             else:
@@ -438,25 +453,32 @@ def _run_train_guarded(cfg: Config, guard: PreemptionGuard) -> TrainState:
             if tag == "stack":
                 # K fused optimizer steps; metrics come back stacked [K] —
                 # log the last sub-step's values (no extra device sync)
-                state, stacked_metrics = loop_step(state, batch)
+                with phases.phase("dispatch"):
+                    state, stacked_metrics = loop_step(state, batch)
+                    if cpu_serial:
+                        jax.block_until_ready(stacked_metrics)
                 metrics = {k: v[-1] for k, v in stacked_metrics.items()}
                 inc = steps_per_loop
                 batch_size = int(batch["label"].shape[1]) * inc
             else:
-                state, metrics = train_step(state, batch)
+                with phases.phase("dispatch"):
+                    state, metrics = train_step(state, batch)
+                    if cpu_serial:
+                        jax.block_until_ready(metrics)
                 inc = 1
                 batch_size = int(batch["label"].shape[0])
-            if cpu_serial:
-                jax.block_until_ready(metrics)
             step += inc
-            log.step(step, batch_size,
-                     {k: v for k, v in metrics.items()
-                      if k != "loss_per_shard"},
-                     extra=lr_extra)
-            # boundary-crossing test: a K-step dispatch may jump past the
-            # exact multiple (identical to `step % N == 0` when inc == 1)
-            if ckpt_every and step // ckpt_every > (step - inc) // ckpt_every:
-                ckpt.save(state)
+            phases.step_done(inc)
+            with phases.phase("host"):
+                log.step(step, batch_size,
+                         {k: v for k, v in metrics.items()
+                          if k != "loss_per_shard"},
+                         extra=lr_extra)
+                # boundary-crossing test: a K-step dispatch may jump past
+                # the exact multiple (same as `step % N == 0` when inc == 1)
+                if (ckpt_every
+                        and step // ckpt_every > (step - inc) // ckpt_every):
+                    ckpt.save(state)
             if eval_enabled and time.time() >= next_eval:
                 run_eval(cfg, ctx, state, log)
                 next_eval = time.time() + cfg.run.eval_throttle_secs
@@ -719,6 +741,16 @@ def run_task(cfg: Config):
     plus ``serve`` — online scoring over the exported servable (the
     TF-Serving step of the reference's workflow, serve/server.py)."""
     task = cfg.run.task_type
+    # arm the flight-recorder termination dump (obs/flight.py): the
+    # train-family tasks below run under a PreemptionGuard, so a SIGTERM
+    # or crash writes model_dir/flight.jsonl — the correlated incident
+    # timeline — next to the checkpoint the guard was preserving.  The
+    # serve task skips it here: serve processes have no guard and expose
+    # the live ring at GET /v1/flight (plus --flight-dump on their CLIs).
+    if cfg.run.model_dir and task != "serve":
+        from ..obs import flight as obs_flight
+
+        obs_flight.install(os.path.join(cfg.run.model_dir, "flight.jsonl"))
     if task in ("online-train", "online_train"):
         # continuous training from the event log at training_data_dir,
         # publishing versioned servables the serve task hot-reloads

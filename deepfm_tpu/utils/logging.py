@@ -82,7 +82,17 @@ class MetricLogger:
     def event(self, kind: str, **fields: Any) -> None:
         record: dict[str, Any] = {"kind": kind}
         for k, v in fields.items():
-            record[k] = float(v) if isinstance(v, (int, float)) else v
+            # coerce-with-fallback, the step() discipline: numpy / jax
+            # scalars are not `int`/`float` instances, and passing them
+            # through raw crashes json.dumps with a TypeError — a metrics
+            # line must never take down the training loop
+            if isinstance(v, (bool, str)) or v is None:
+                record[k] = v
+                continue
+            try:
+                record[k] = float(v)
+            except (TypeError, ValueError):
+                record[k] = v
         self._emit(record)
 
     def _emit(self, record: dict) -> None:

@@ -201,6 +201,12 @@ class CircuitBreaker:
         self._probe_started = None
         self._window.clear()
         self.open_total += 1
+        # breaker transitions are incident landmarks: one line in the
+        # flight-recorder timeline (obs/flight.py) per open/close
+        from ..obs import flight as _flight
+
+        _flight.record("breaker_open", breaker=self.name or "breaker",
+                       open_total=self.open_total)
 
     # -- caller protocol -----------------------------------------------------
     def allow(self) -> bool:
@@ -230,6 +236,10 @@ class CircuitBreaker:
                 self._probe_inflight = False
                 self._probe_started = None
                 self._window.clear()
+                from ..obs import flight as _flight
+
+                _flight.record("breaker_close",
+                               breaker=self.name or "breaker")
             else:
                 self._window.append(True)
 

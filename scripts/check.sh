@@ -48,10 +48,17 @@ fi
 # under transfer_guard('disallow') with the table as a lowered parameter
 # (no host round-trip on table leaves) and the redistribution plan must
 # stay minimal-traffic (a same-width shrink plans ZERO table bytes).
+# — and the OBSERVABILITY contract (audit_observability): the unified obs
+# layer (deepfm_tpu/obs) must never enter lowered code — the serving
+# predict and train step lower under transfer_guard('disallow') with no
+# host-callback custom_calls in the module and lower deterministically
+# across fresh builds (a host-timer value captured by the trace bakes a
+# different constant per retrace).
 # Seeded violations in tests/test_analysis.py (smuggled transfer,
 # dense-row leak, off-bucket/indivisible shape, baked mixed-generation
 # payload, full-corpus score gather, baked index, reshard host round-trip,
-# baked reshard table) prove each contract actually catches its
+# baked reshard table, host timer closed over a traced value, registry
+# call inside a jitted fn) prove each contract actually catches its
 # regression.
 exec env JAX_PLATFORMS=cpu \
     XLA_FLAGS="${XLA_FLAGS:+$XLA_FLAGS }--xla_force_host_platform_device_count=8" \

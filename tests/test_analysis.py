@@ -1403,3 +1403,109 @@ class TestElasticReshardContract:
         findings = audit_elastic(reshard_builder=baked_builder)
         assert any(f.rule == "trace-transfer"
                    and "baked" in f.message for f in findings), findings
+
+
+# ------------------------------------------------------------ observability
+
+class TestObservabilityAudit:
+    """audit_observability: instrumentation never enters lowered code.
+    The real entrypoints pass (covered by
+    test_real_entrypoints_hold_all_contracts, which runs every engine-2
+    audit); each seeded violation here is a way a well-meaning metrics
+    patch could smuggle observability INTO the executables."""
+
+    def test_real_predict_and_step_hold_the_contract(self):
+        from deepfm_tpu.analysis.trace_audit import audit_observability
+
+        findings = audit_observability()
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_seeded_host_timer_in_trace_caught(self):
+        """A host timer read at trace time (the 'time the kernel from
+        inside' mistake) bakes a different constant per retrace —
+        convicted by the determinism check."""
+        import time
+
+        import jax
+        import numpy as np
+
+        from deepfm_tpu.analysis.trace_audit import audit_observability
+
+        def timer_builder(model, cfg):
+            @jax.jit
+            def predict_with(payload, feat_ids, feat_vals):
+                logits, _ = model.apply(
+                    payload["params"], payload["model_state"],
+                    feat_ids, feat_vals, cfg=cfg.model, train=False,
+                )
+                # the timer value is CLOSED OVER by the traced function
+                c = np.float32(time.perf_counter())
+                return jax.nn.sigmoid(logits) + c - c
+
+            return predict_with
+
+        findings = audit_observability(predict_builder=timer_builder)
+        assert any(f.rule == "trace-observability"
+                   and "lowerings" in f.message for f in findings), \
+            "\n".join(f.render() for f in findings)
+
+    def test_seeded_registry_callback_in_jit_caught(self):
+        """A registry call smuggled under jit via debug.callback lowers
+        as a host-callback custom_call — convicted by the callback scan."""
+        import jax
+
+        from deepfm_tpu.analysis.trace_audit import audit_observability
+        from deepfm_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        hist = reg.histogram("deepfm_seeded_scores", "seeded violation")
+
+        def callback_builder(model, cfg):
+            @jax.jit
+            def predict_with(payload, feat_ids, feat_vals):
+                logits, _ = model.apply(
+                    payload["params"], payload["model_state"],
+                    feat_ids, feat_vals, cfg=cfg.model, train=False,
+                )
+                out = jax.nn.sigmoid(logits)
+                jax.debug.callback(
+                    lambda v: hist.observe(float(v)), out[0]
+                )
+                return out
+
+            return predict_with
+
+        findings = audit_observability(predict_builder=callback_builder)
+        assert any(f.rule == "trace-observability"
+                   and "host callback" in f.message for f in findings), \
+            "\n".join(f.render() for f in findings)
+
+    def test_seeded_registry_call_on_traced_value_caught(self):
+        """A DIRECT registry call on a traced value inside the train step
+        concretizes the tracer — the audit reports the lowering failure
+        as a finding instead of crashing."""
+        import jax
+
+        from deepfm_tpu.analysis.trace_audit import audit_observability
+        from deepfm_tpu.obs.metrics import MetricsRegistry
+        from deepfm_tpu.train.step import create_train_state, make_train_step
+
+        reg = MetricsRegistry()
+        loss_hist = reg.histogram("deepfm_seeded_loss", "seeded violation")
+
+        def step_builder(cfg):
+            inner = make_train_step(cfg)
+
+            def bad_step(state, batch):
+                new_state, metrics = inner(state, batch)
+                loss_hist.observe(float(metrics["loss"]))  # traced value!
+                return new_state, metrics
+
+            return jax.jit(bad_step, donate_argnums=(0,))
+
+        findings = audit_observability(step_builder=step_builder)
+        assert any(f.rule == "trace-observability"
+                   and "train step" in f.message for f in findings), \
+            "\n".join(f.render() for f in findings)
+        # keep create_train_state imported for the abstract state shape
+        assert callable(create_train_state)

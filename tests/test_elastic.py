@@ -342,6 +342,41 @@ def test_shrink_grow_mid_run_matches_uninterrupted_oracle(tmp_path, lazy):
         assert want in kinds, kinds
 
 
+def test_shrink_leaves_flight_recorder_timeline(tmp_path):
+    """The chaos-forensics acceptance path (obs/flight.py): an elastic
+    shrink leaves ``elastic_*`` lifecycle events in the process flight
+    recorder, and the JSONL dump is a seq-ordered incident timeline
+    containing them — what a SIGTERM/crash during the drill would have
+    written via ``run_task``'s ``model_dir/flight.jsonl`` arming."""
+    import json
+
+    from deepfm_tpu.obs import flight as obs_flight
+    from deepfm_tpu.obs.flight import FlightRecorder
+
+    root = tmp_path / "elastic"
+    cfg = _cfg(str(root))
+    _fill_stream(cfg.data.training_data_dir, segments=6, rows=8)
+    devs = jax.devices()[:4]
+    reg = VirtualDeviceRegistry(devs)
+    prev = obs_flight.set_recorder(FlightRecorder(256))
+    try:
+        _run_elastic(cfg, reg, script={3: lambda: reg.fail(2, 3)})
+        kinds = [e["kind"] for e in obs_flight.get_recorder().events()]
+        for want in ("elastic_detect", "elastic_drain_commit",
+                     "elastic_reshard"):
+            assert want in kinds, (want, kinds)
+        path = obs_flight.get_recorder().dump(
+            str(tmp_path / "flight.jsonl"), reason="drill")
+        lines = [json.loads(x) for x in open(path)]
+        assert lines[0]["kind"] == "flight_dump"
+        seqs = [e["seq"] for e in lines[1:]]
+        assert seqs == sorted(seqs)                # one ordered timeline
+        resh = next(e for e in lines if e["kind"] == "elastic_reshard")
+        assert resh["to_mesh"] == [1, 2]
+    finally:
+        obs_flight.set_recorder(prev)
+
+
 def test_uncommitted_tail_replays_exactly_once_without_drain(tmp_path):
     """drain_commit=False models a hard slice loss: the uncommitted tail
     must REPLAY from the last periodic commit — and still match the

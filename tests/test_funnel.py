@@ -553,3 +553,54 @@ class TestFunnelConfigValidation:
                 build_serve_mesh(2, 4), capacity=CAPACITY,
                 top_k=CAPACITY // 4 + 1,
             )
+
+
+def test_recommend_traceable_end_to_end(funnel_env):
+    """A recommend request is traceable router -> funnel member ->
+    engine: the response carries the trace id and both hops' recent
+    buffers show the same trace with stage spans (obs/trace.py)."""
+    from deepfm_tpu.obs.trace import TRACE_HEADER
+    from deepfm_tpu.serve.pool.router import start_router
+    from deepfm_tpu.serve.pool.sharded import build_serve_mesh
+    from deepfm_tpu.serve.pool.worker import start_member
+
+    httpd, url, member = start_member(
+        funnel_env["servable"], build_serve_mesh(1, 2, group_index=1),
+        group="gt", buckets=BUCKETS, max_wait_ms=0.0,
+    )
+    r_httpd, r_url, router = start_router({"gt": [url]},
+                                          probe_interval_secs=30.0)
+    trace_id = "feedbeefcafe5678"
+    try:
+        rng = np.random.default_rng(5)
+        req = urllib.request.Request(
+            f"{r_url}/v1/recommend",
+            data=json.dumps({"instances": _instances(rng, 2)}).encode(),
+            headers={"Content-Type": "application/json",
+                     TRACE_HEADER: trace_id},
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            doc = json.load(r)
+            assert r.headers[TRACE_HEADER] == trace_id
+        assert len(doc["items"]) == 2
+
+        def recent(base):
+            with urllib.request.urlopen(f"{base}/v1/trace/recent",
+                                        timeout=30) as r:
+                return {t["trace_id"]: t
+                        for t in json.load(r)["traces"]}
+
+        rtr = recent(r_url)[trace_id]
+        fwd = [s for s in rtr["spans"] if s["name"] == "router.forward"]
+        assert fwd and fwd[-1]["status"] == 200 and fwd[-1]["group"] == "gt"
+        assert rtr["name"] == "recommend"
+        wtr = recent(url)[trace_id]
+        names = [s["name"] for s in wtr["spans"]]
+        assert any(n.endswith(".queue") for n in names)
+        assert any(n.endswith(".dispatch") for n in names)
+    finally:
+        router.close()
+        r_httpd.shutdown()
+        r_httpd.server_close()
+        httpd.shutdown()
+        httpd.server_close()

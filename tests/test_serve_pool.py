@@ -892,3 +892,151 @@ def test_swapper_rolls_back_ahead_member(pool_env):
     finally:
         h.shutdown()
         m.close()
+
+
+# --------------------------------------------------------------------------
+# end-to-end request tracing (obs/trace.py): router -> worker -> engine
+
+
+def _post_traced(url, payload, headers=None, timeout=60):
+    """_post, but also returning the response headers (the trace id rides
+    X-Trace-Id)."""
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.load(r), dict(r.headers)
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return json.load(r)
+
+
+def test_trace_propagates_router_worker_engine(pool_env):
+    """One predict request is ONE trace end-to-end: the router mints (or
+    adopts) the X-Trace-Id, the member adopts it over the propagation
+    headers, the engine attaches queue/dispatch spans — and the 409
+    skew-abort retry REUSES the original trace id, so a re-pinned
+    request never splits into two traces."""
+    from deepfm_tpu.obs.trace import TRACE_HEADER
+    from deepfm_tpu.serve.pool.sharded import build_serve_mesh
+    from deepfm_tpu.serve.pool.worker import start_member
+
+    pool_env["plan"].clear()
+    h, u, m = start_member(
+        pool_env["servable"], build_serve_mesh(1, 2, group_index=0),
+        group="g0", buckets=(4, 8), max_wait_ms=1.0,
+        exchange="alltoall",
+    )
+    rh, rurl, router = start_router(
+        {"g0": [u]}, retry_limit=1, probe_interval_secs=30.0,
+    )
+    router.tracer.sample_rate = 1.0   # deterministic mint for the test
+    try:
+        # -- minted at the router ---------------------------------------
+        doc, headers = _post_traced(f"{rurl}/v1/models/deepfm:predict",
+                                    {"instances": _instances(2)})
+        minted = headers[TRACE_HEADER]
+        assert minted and len(doc["predictions"]) == 2
+
+        # -- adopted from the client ------------------------------------
+        client_id = "deadbeefcafe0123"
+        doc, headers = _post_traced(
+            f"{rurl}/v1/models/deepfm:predict",
+            {"instances": _instances(3)},
+            headers={TRACE_HEADER: client_id},
+        )
+        assert headers[TRACE_HEADER] == client_id
+
+        # router side: every trace shows the forward span with status
+        rrec = {t["trace_id"]: t
+                for t in _get_json(f"{rurl}/v1/trace/recent")["traces"]}
+        for tid in (minted, client_id):
+            spans = rrec[tid]["spans"]
+            fwd = [s for s in spans if s["name"] == "router.forward"]
+            assert fwd and fwd[-1]["status"] == 200
+            assert fwd[-1]["group"] == "g0"
+
+        # worker side: SAME trace ids, engine spans with stage timings
+        wrec = {t["trace_id"]: t
+                for t in _get_json(f"{u}/v1/trace/recent")["traces"]}
+        for tid in (minted, client_id):
+            names = [s["name"] for s in wrec[tid]["spans"]]
+            assert any(n.endswith(".queue") for n in names)
+            assert any(n.endswith(".dispatch") for n in names)
+            d = next(s for s in wrec[tid]["spans"]
+                     if s["name"].endswith(".dispatch"))
+            assert d["bucket"] in (4, 8) and d["duration_ms"] >= 0
+
+        # -- 409 skew-abort retry reuses the ORIGINAL trace id ----------
+        m.generation += 1   # router's pin (gen 0) is now stale
+        skew_id = "0123456789abcdef"
+        doc, headers = _post_traced(
+            f"{rurl}/v1/models/deepfm:predict",
+            {"instances": _instances(2)},
+            headers={TRACE_HEADER: skew_id},
+        )
+        assert headers[TRACE_HEADER] == skew_id     # same trace id
+        assert router.skew_aborts_total == 1
+        assert doc["group_generation"] == 1
+        rrec = {t["trace_id"]: t
+                for t in _get_json(f"{rurl}/v1/trace/recent")["traces"]}
+        fwd = [s for s in rrec[skew_id]["spans"]
+               if s["name"] == "router.forward"]
+        # one trace, two attempts: the abort and the re-pinned success
+        assert [s["status"] for s in fwd] == [409, 200]
+        assert {s["attempt"] for s in fwd} == {1, 2}
+        wrec = {t["trace_id"]: t
+                for t in _get_json(f"{u}/v1/trace/recent")["traces"]}
+        assert any(s["name"].endswith(".dispatch")
+                   for s in wrec[skew_id]["spans"])
+        # the member logged the abort to the flight recorder
+        from deepfm_tpu.obs import flight as obs_flight
+
+        aborts = obs_flight.get_recorder().events(kind="skew_abort")
+        assert aborts and aborts[-1]["group"] == "g0"
+    finally:
+        router.close()
+        rh.shutdown()
+        h.shutdown()
+        m.close()
+
+
+def test_worker_prometheus_and_flight_surfaces(pool_env):
+    """Every pool HTTP surface serves GET /metrics (Prometheus text) and
+    GET /v1/flight; the member's engine metrics carry the engine label."""
+    from deepfm_tpu.serve.pool.sharded import build_serve_mesh
+    from deepfm_tpu.serve.pool.worker import start_member
+
+    h, u, m = start_member(
+        pool_env["servable"], build_serve_mesh(1, 2, group_index=1),
+        group="gm", buckets=(4, 8), max_wait_ms=1.0,
+        exchange="alltoall",
+    )
+    rh, rurl, router = start_router(
+        {"gm": [u]}, probe_interval_secs=30.0,
+    )
+    try:
+        _post(f"{u}/v1/models/deepfm:predict",
+              {"instances": _instances(2)})
+        with urllib.request.urlopen(f"{u}/metrics", timeout=30) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        assert ('deepfm_serve_requests_total{engine="predict[gm/m0]"} 1'
+                in text)
+        _post(f"{rurl}/v1/models/deepfm:predict",
+              {"instances": _instances(1)})
+        with urllib.request.urlopen(f"{rurl}/metrics", timeout=30) as r:
+            rtext = r.read().decode()
+        assert "deepfm_router_requests_total 1" in rtext
+        assert ('deepfm_router_group_requests_total{group="gm"} 1'
+                in rtext)
+        for base in (u, rurl):
+            assert "events" in _get_json(f"{base}/v1/flight")
+    finally:
+        router.close()
+        rh.shutdown()
+        h.shutdown()
+        m.close()
