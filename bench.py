@@ -374,6 +374,26 @@ def main() -> None:
 
         r = obs_overhead.main()
         sys.exit(0 if r["within_noise"] else 1)
+    if len(sys.argv) > 1 and sys.argv[1] == "--multitenant":
+        # the multi-tenant fleet gate (benchmarks/multitenant.py): 4
+        # same-spec tenants + 1 shadow challenger on a 2-group pool —
+        # per-tenant p50/p99 vs the single-tenant baseline, a mid-load
+        # single-tenant swap (FAILS on any failed / mixed-version /
+        # cross-tenant-contaminated response), and a paired toggled-window
+        # check that shadow scoring adds no response-path latency.  Emits
+        # docs/BENCH_MULTITENANT.json.  CPU virtual mesh by design — the
+        # drill measures the fleet control plane, not chip throughput.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        sys.argv = [sys.argv[0], "--persist"] + sys.argv[2:]
+        import multitenant
+
+        r = multitenant.main()
+        sys.exit(0 if r["ok"] else 1)
     if len(sys.argv) > 1 and sys.argv[1] == "--elastic":
         # the elastic chaos drill (benchmarks/elastic_drill.py): shrink
         # [2,4]→[1,4] and grow back mid-run under serving load; emits

@@ -79,6 +79,15 @@ not a benchmark:
   same-spec payloads lower identically (a group swap is a cache hit —
   no mixed-generation executable can exist).
 
+* **multitenant audit** — the fleet's executable-sharing contract
+  (``deepfm_tpu/fleet``): two DISTINCT same-spec tenant payloads must
+  lower through ONE shard-group predict to IDENTICAL modules with the
+  payload leaves as lowered PARAMETERS — tenant selection is a payload
+  pick, never a recompile, so N tenants on one pool cost N payloads and
+  zero extra executables.  Catches both seeded regressions: a
+  spec-divergent tenant claiming shared executables, and a tenant
+  payload baked in as constants.
+
 * **observability audit** — the unified obs layer (``deepfm_tpu/obs``)
   must never enter lowered code: the real serving predict and train step
   lower under ``transfer_guard('disallow')`` with NO host callbacks in
@@ -989,6 +998,146 @@ def audit_sharded_predict(cfg=None, predict_builder=None) -> list[Finding]:
     return out
 
 
+def audit_multitenant(cfg=None, predict_builder=None,
+                      tenant_models=None) -> list[Finding]:
+    """The fleet's executable-sharing contract (deepfm_tpu/fleet): N
+    same-spec tenants on one pool serve from ONE precompiled executable
+    set — tenant selection is a payload pick, never a recompile.
+
+    Lower the shard-group predict ONCE (the claimed shared executable)
+    and feed it two DISTINCT tenant payloads:
+
+    * **identical modules** — every tenant payload of the pool spec must
+      lower to the same input signature and the same module text: a
+      divergent lowering means a tenant claimed executables it cannot
+      share (each request would recompile or serve a per-tenant module);
+    * **payload leaves as parameters** — the tenant's weights must appear
+      as lowered PARAMETERS, not baked constants: a baked tenant payload
+      is the per-tenant-module regression in disguise (every tenant swap
+      compiles, and mid-swap the members serve mixed-tenant executables);
+    * **transfer-guard-clean** — tenant payloads enter through the
+      declared arguments only.
+
+    ``tenant_models`` (per-tenant model-override dicts, default two
+    same-spec tenants) and ``predict_builder`` let the seeded-violation
+    tests (tests/test_analysis.py) feed spec-DIVERGENT tenants claiming
+    one executable, and a tenant payload baked as a constant, through
+    the same checks."""
+    import sys
+
+    import jax
+
+    if len(jax.devices()) < 8:
+        print(
+            "trace-audit: multitenant contract SKIPPED — needs >= 8 "
+            "devices (run under JAX_PLATFORMS=cpu with "
+            "--xla_force_host_platform_device_count=8; scripts/check.sh "
+            "and the analysis CLI arrange this)",
+            file=sys.stderr,
+        )
+        return []
+    from ..core.config import tenant_spec_divergence
+    from ..serve.pool.sharded import (
+        abstract_serve_payload,
+        build_serve_mesh,
+        build_sharded_predict_with,
+        make_serve_context,
+    )
+
+    base = cfg or _audit_cfg()
+    where = "deepfm_tpu/fleet/registry.py"
+    out: list[Finding] = []
+    overrides = list(tenant_models) if tenant_models is not None \
+        else [{}, {}]
+    mesh = build_serve_mesh(2, 4)
+    ctx = make_serve_context(base, mesh, exchange="alltoall")
+    predict_with = (predict_builder or build_sharded_predict_with)(ctx)
+    f = ctx.cfg.model.field_size
+    b = _default_buckets()[0]
+    args = (
+        jax.ShapeDtypeStruct((b, f), jax.numpy.int64),
+        jax.ShapeDtypeStruct((b, f), jax.numpy.float32),
+    )
+
+    def lower_with(pay):
+        try:
+            return predict_with.lower(pay, *args)
+        except TypeError:
+            # a predict that dropped the payload argument (tenant weights
+            # baked in) still lowers; the leaf-count contract convicts it
+            return predict_with.lower(*args)
+
+    import dataclasses as _dc
+
+    base_model = _dc.asdict(base.model)
+    ref = None
+    for i, ov in enumerate(overrides):
+        t_cfg = base.with_overrides(model=ov) if ov else base
+        t_ctx = (make_serve_context(t_cfg, mesh, exchange="alltoall")
+                 if ov else ctx)
+        payload = abstract_serve_payload(t_ctx)
+        diff = tenant_spec_divergence(base_model, ov)
+        try:
+            with jax.transfer_guard("disallow"):
+                lo = lower_with(payload)
+        except Exception as e:
+            out.append(_finding(
+                "trace-recompile",
+                f"tenant {i}'s payload cannot lower through the pool's "
+                f"shared executable ({type(e).__name__}: {e}) — a "
+                f"spec-divergent tenant is claiming one executable"
+                + (f" (diverging fields: {diff})" if diff else ""),
+                hint="same-spec tenants only: serve a divergent spec "
+                     "from its own pool (core.config."
+                     "EXECUTABLE_SPEC_FIELDS)",
+                where=where, slug=f"multitenant-{i}-lower",
+            ))
+            continue
+        if ref is None:
+            ref = lo
+            # payload leaves as lowered parameters — the baked-tenant
+            # discriminator
+            n_payload = len(jax.tree_util.tree_leaves(payload))
+            n_in = len(jax.tree_util.tree_leaves(lo.in_avals))
+            if n_in != n_payload + 2:
+                out.append(_finding(
+                    "trace-recompile",
+                    f"the shared predict has {n_in} input leaves, "
+                    f"expected {n_payload} payload leaves + ids + vals — "
+                    f"a tenant payload was baked in as constants (every "
+                    f"tenant swap would compile a NEW executable and "
+                    f"members would serve per-tenant modules)",
+                    hint="jit the payload-as-argument form "
+                         "(serve/pool/sharded.py "
+                         "build_sharded_predict_with)",
+                    where=where, slug="multitenant-baked",
+                ))
+            continue
+        if lo.in_avals != ref.in_avals:
+            out.append(_finding(
+                "trace-recompile",
+                f"tenant {i}'s payload changed the lowered input "
+                f"signature — spec-divergent tenants claiming one "
+                f"executable (every request mixing tenants would "
+                f"recompile)"
+                + (f"; diverging fields: {diff}" if diff else ""),
+                hint="same-spec tenants only (core.config."
+                     "EXECUTABLE_SPEC_FIELDS); the fleet registry and "
+                     "config validation both refuse this at load",
+                where=where, slug=f"multitenant-{i}-signature",
+            ))
+        elif lo.as_text() != ref.as_text():
+            out.append(_finding(
+                "trace-recompile",
+                f"tenant {i}'s same-spec payload lowered to a DIFFERENT "
+                f"module — tenant identity leaked into the executable "
+                f"(the pool would serve per-tenant modules)",
+                hint="no host reads of the payload inside the predict",
+                where=where, slug=f"multitenant-{i}-module",
+            ))
+    return out
+
+
 # ---------------------------------------------------------------------------
 # funnel contract (recommendation funnel, deepfm_tpu/funnel)
 
@@ -1472,6 +1621,7 @@ def run_trace_audit(cfg=None) -> list[Finding]:
     findings.extend(audit_paged_step(cfg))
     findings.extend(audit_spmd_exchange(cfg))
     findings.extend(audit_sharded_predict(cfg))
+    findings.extend(audit_multitenant(cfg))
     findings.extend(audit_funnel(cfg))
     findings.extend(audit_elastic(cfg))
     findings.extend(audit_observability(cfg))

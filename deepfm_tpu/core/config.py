@@ -44,6 +44,116 @@ def _parse_float_list(s: str | Sequence[float]) -> tuple[float, ...]:
     return tuple(float(x) for x in s)
 
 
+# ---- multi-tenant fleet (deepfm_tpu/fleet) --------------------------------
+
+# ModelConfig fields that determine the serving EXECUTABLES — the payload
+# avals and the lowered bucket modules.  Two tenants may share one
+# precompiled executable set iff they agree on ALL of these (the
+# audit_multitenant trace contract proves the sharing at lowering level);
+# everything else (learning rate, l2, dropout — training-time knobs) is
+# tenant-local and free to differ.
+EXECUTABLE_SPEC_FIELDS = (
+    "model_name", "feature_size", "field_size", "embedding_size",
+    "deep_layers", "cin_layers", "cross_layers", "batch_norm",
+    "tower_layers", "tower_dim", "user_vocab_size", "item_vocab_size",
+    "user_field_size", "item_field_size", "compute_dtype", "narrow_ids",
+    "table_grad", "fused_kernel", "shard_exchange",
+    "shard_exchange_capacity", "tiered_embeddings",
+)
+
+# keys a fleet tenant entry may carry (core/config.py and fleet/registry.py
+# share ONE schema; a typo'd key raises instead of silently doing nothing)
+TENANT_ENTRY_KEYS = ("name", "source", "split_percent", "shadow_of",
+                     "model")
+
+
+def _spec_norm(v: Any) -> Any:
+    return tuple(v) if isinstance(v, list) else v
+
+
+def tenant_spec_divergence(base_model: dict, overrides: dict) -> list[str]:
+    """Executable-spec fields where a tenant's ``model`` overrides diverge
+    from the pool's base model section.  Non-empty means the tenant CANNOT
+    share the pool's precompiled executables (its payload would lower to a
+    different module) — the fleet refuses it at config load instead of
+    recompiling mid-traffic."""
+    return sorted(
+        k for k in overrides
+        if k in EXECUTABLE_SPEC_FIELDS
+        and _spec_norm(overrides[k]) != _spec_norm(base_model.get(k))
+    )
+
+
+def validate_tenant_entries(entries) -> tuple:
+    """Normalize + validate a fleet tenant list (dicts or JSON text):
+    duplicate names raise, split percentages of the serving (non-shadow)
+    arms must sum to 100 when any is set, shadow entries must reference an
+    existing non-shadow incumbent and take no split.  Returns the
+    normalized tuple of entry dicts.  Spec-compatibility against the base
+    model section is the cross-section half, checked in
+    ``Config.__post_init__`` (and re-checked with manifests by
+    ``fleet/registry.py``)."""
+    if isinstance(entries, str):
+        entries = json.loads(entries) if entries.strip() else []
+    norm = []
+    for i, e in enumerate(entries):
+        if not isinstance(e, dict):
+            raise ValueError(
+                f"fleet.tenants[{i}] must be an object, got {type(e).__name__}"
+            )
+        unknown = sorted(set(e) - set(TENANT_ENTRY_KEYS))
+        if unknown:
+            raise ValueError(
+                f"fleet.tenants[{i}] has unknown key(s) {unknown} "
+                f"(known: {list(TENANT_ENTRY_KEYS)})"
+            )
+        name = str(e.get("name", "")).strip()
+        if not name:
+            raise ValueError(f"fleet.tenants[{i}] is missing a name")
+        norm.append({
+            "name": name,
+            "source": str(e.get("source", "")),
+            "split_percent": float(e.get("split_percent", 0.0)),
+            "shadow_of": str(e.get("shadow_of", "")),
+            "model": dict(e.get("model") or {}),
+        })
+    names = [e["name"] for e in norm]
+    dups = sorted({n for n in names if names.count(n) > 1})
+    if dups:
+        raise ValueError(f"duplicate fleet tenant name(s): {dups}")
+    by_name = {e["name"]: e for e in norm}
+    serving = [e for e in norm if not e["shadow_of"]]
+    for e in norm:
+        if e["split_percent"] < 0:
+            raise ValueError(
+                f"tenant {e['name']!r}: split_percent must be >= 0, got "
+                f"{e['split_percent']}"
+            )
+        if e["shadow_of"]:
+            ref = by_name.get(e["shadow_of"])
+            if ref is None or ref["shadow_of"]:
+                raise ValueError(
+                    f"shadow tenant {e['name']!r} references "
+                    f"{e['shadow_of']!r}, which is not a serving (non-"
+                    f"shadow) tenant"
+                )
+            if e["split_percent"]:
+                raise ValueError(
+                    f"shadow tenant {e['name']!r} cannot take live split "
+                    f"traffic (split_percent="
+                    f"{e['split_percent']}); it scores the sampled stream "
+                    f"off the response path"
+                )
+    total = sum(e["split_percent"] for e in serving)
+    if any(e["split_percent"] for e in serving) and abs(total - 100.0) > 1e-6:
+        raise ValueError(
+            f"fleet split percentages must sum to 100, got {total:g} over "
+            f"{[e['name'] for e in serving]} — every key must land on "
+            f"exactly one arm"
+        )
+    return tuple(norm)
+
+
 def packed_sort_id_bound(n: int) -> int:
     """Largest EXCLUSIVE id bound the packed single-key sort accepts for an
     ``n``-id stream (``ops/embedding.py sort_segments``): the (id,
@@ -336,6 +446,50 @@ class ElasticConfig:
 
 
 @dataclass(frozen=True)
+class FleetConfig:
+    """Multi-tenant model fleet (``deepfm_tpu/fleet``): N model variants
+    served from ONE shard-group pool's precompiled executables.  Weights
+    ride the executables as jit ARGUMENTS (serve/reload.py, serve/pool/
+    sharded.py), so same-spec tenants cost one payload each and ZERO extra
+    executables — variant selection is a payload pick, not a recompile
+    (the ``audit_multitenant`` trace contract pins this).  The router
+    splits traffic hash-stably across the serving tenants, shadow tenants
+    score a sampled slice of the live stream off the response path, and
+    each tenant hot-swaps group-atomically without touching its
+    neighbours."""
+
+    # tenant bindings: JSON text or a list of entry objects —
+    #   [{"name": "prod", "source": "<publish root>", "split_percent": 90},
+    #    {"name": "exp",  "source": "...", "split_percent": 10},
+    #    {"name": "challenger", "source": "...", "shadow_of": "prod"}]
+    # ``model`` may carry executable-NEUTRAL overrides; a tenant whose
+    # model overrides touch an executable-spec field is refused at load
+    # (Config.__post_init__ names the differing fields).
+    tenants: tuple = ()
+    # fraction of the incumbent's live stream the shadow challenger scores
+    # (hash-stable per key, like the split itself)
+    shadow_sample_percent: float = 100.0
+    # bounded shadow queue: offers beyond this depth are SHED (counted) —
+    # the shadow path may lose samples under load, never add latency
+    shadow_queue_depth: int = 128
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "tenants", validate_tenant_entries(self.tenants)
+        )
+        if not 0.0 <= self.shadow_sample_percent <= 100.0:
+            raise ValueError(
+                f"fleet.shadow_sample_percent must be in [0, 100], got "
+                f"{self.shadow_sample_percent}"
+            )
+        if self.shadow_queue_depth < 1:
+            raise ValueError(
+                f"fleet.shadow_queue_depth must be >= 1, got "
+                f"{self.shadow_queue_depth}"
+            )
+
+
+@dataclass(frozen=True)
 class RunConfig:
     """Run/driver config: task dispatch + paths (ps:70-79) + cluster identity
     (SM_HOSTS/SM_CURRENT_HOST analogs, ps:80-95)."""
@@ -449,6 +603,7 @@ class Config:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     run: RunConfig = field(default_factory=RunConfig)
     elastic: ElasticConfig = field(default_factory=ElasticConfig)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
 
     def __post_init__(self):
         """Cross-section contracts no single section can check.
@@ -588,6 +743,24 @@ class Config:
                         f"run.serve_buckets or raise funnel_top_k",
                         stacklevel=2,
                     )
+        # 5. multi-tenant fleet spec compatibility: every tenant on the
+        # pool must share the pool's executable spec (weights ride as jit
+        # arguments, so same-spec tenants serve from ONE precompiled
+        # executable set — audit_multitenant proves it at lowering level).
+        # A tenant whose model overrides touch an executable-spec field
+        # would force per-tenant modules: refuse at load, naming the
+        # fields, instead of recompiling mid-traffic.
+        base_model = dataclasses.asdict(m)
+        for t in self.fleet.tenants:
+            diff = tenant_spec_divergence(base_model, t["model"])
+            if diff:
+                raise ValueError(
+                    f"fleet tenant {t['name']!r} diverges from its "
+                    f"executable-sharing group on {diff}: same-spec "
+                    f"tenants must share ONE precompiled executable set "
+                    f"(EXECUTABLE_SPEC_FIELDS) — serve a divergent spec "
+                    f"from its own pool instead"
+                )
 
     # ---- overrides ------------------------------------------------------
 
@@ -637,6 +810,9 @@ class Config:
             run=RunConfig(**known(RunConfig, d.get("run", {}), "run")),
             elastic=ElasticConfig(
                 **known(ElasticConfig, d.get("elastic", {}), "elastic")
+            ),
+            fleet=FleetConfig(
+                **known(FleetConfig, d.get("fleet", {}), "fleet")
             ),
         )
 

@@ -1,0 +1,28 @@
+"""Multi-tenant model fleet: N model variants on ONE serving pool.
+
+The weights-as-jit-ARGUMENTS discipline (serve/reload.py, serve/pool/
+sharded.py) means every same-spec model variant serves from the SAME
+precompiled bucket executables — variant selection is a payload pick, not
+a recompile.  This package is the control plane over that fact: the
+tenant registry (registry.py), hash-stable traffic splitting (split.py),
+and off-response-path shadow scoring (shadow.py).  The serving pool
+(serve/pool/) keys its payload holders, coalescing queues, generations
+and the group-atomic swap protocol by tenant; the ``audit_multitenant``
+trace contract (analysis/trace_audit.py) pins the executable sharing.
+"""
+
+from .registry import DEFAULT_TENANT, TenantRegistry, TenantSpec, parse_tenants
+from .shadow import ShadowScorer
+from .split import SPACE, TrafficSplit, sampled, split_point
+
+__all__ = [
+    "DEFAULT_TENANT",
+    "SPACE",
+    "ShadowScorer",
+    "TenantRegistry",
+    "TenantSpec",
+    "TrafficSplit",
+    "parse_tenants",
+    "sampled",
+    "split_point",
+]

@@ -1,0 +1,115 @@
+"""Hash-stable traffic splitting: key → tenant arm, stable forever.
+
+The router needs three properties no round-robin or random pick gives:
+
+* **Stickiness** — a user (routing key) always lands on the same arm,
+  across requests, across router restarts, and across routers: the arm is
+  a pure function of the key bytes and the declared percentages, with no
+  state to lose.  (An A/B experiment where a user flips arms mid-session
+  measures nothing.)
+* **Exactness** — arm shares converge to the declared percentages because
+  keys map uniformly onto a fixed integer space (``SPACE`` points) that
+  the arms partition by cumulative percentage.
+* **Minimal movement on re-split** — changing percentages moves only the
+  keys in the boundary windows that actually shifted (for a two-arm
+  split, exactly the |Δ| share, all in one direction), because arms keep
+  their DECLARED order and only the cumulative boundaries move — the
+  consistent-hash-ring churn discipline (serve/pool/router.HashRing)
+  applied to percentage space.
+
+Pure control plane: no jax, importable anywhere the router runs.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import threading
+
+# hash-space granularity: percentages resolve to 1e-4 of traffic
+SPACE = 1_000_000
+
+
+def split_point(key: str, salt: str = "") -> int:
+    """Deterministic uniform point in ``[0, SPACE)`` for ``key`` — a pure
+    function of the bytes (md5, like the routing ring), so the same key
+    lands on the same point on every router, forever.  ``salt`` decouples
+    independent decisions on the same key stream (the shadow sampler must
+    not correlate with the split arms)."""
+    h = hashlib.md5(f"{salt}|{key}".encode()).digest()
+    return int.from_bytes(h[:8], "big") % SPACE
+
+
+def sampled(key: str, percent: float, salt: str = "shadow") -> bool:
+    """Hash-stable Bernoulli(percent/100) decision for ``key`` — the
+    shadow scorer's sampling gate: the SAME keys are always the sampled
+    slice, so challenger-vs-incumbent divergence compares like with
+    like."""
+    return split_point(key, salt) < int(percent / 100.0 * SPACE)
+
+
+class TrafficSplit:
+    """Percentage split over named arms with hash-stable assignment.
+
+    ``arms`` maps arm name → percent (must sum to 100); iteration order is
+    the DECLARED order and is part of the contract: boundaries are
+    cumulative in that order, so two routers built from the same config
+    agree on every key, and a percentage change moves only the boundary
+    windows (``set_percentages`` keeps retained arms in their original
+    positions; new arms append)."""
+
+    def __init__(self, arms: dict[str, float]):
+        self._lock = threading.Lock()
+        self._order: list[str] = []
+        self._percent: dict[str, float] = {}
+        self._bounds: list[int] = []
+        with self._lock:
+            self._rebuild(dict(arms))
+
+    @staticmethod
+    def _validate(arms: dict[str, float]) -> None:
+        if not arms:
+            raise ValueError("a traffic split needs at least one arm")
+        for name, p in arms.items():
+            if p < 0:
+                raise ValueError(f"arm {name!r}: percent must be >= 0, "
+                                 f"got {p}")
+        total = sum(arms.values())
+        if abs(total - 100.0) > 1e-6:
+            raise ValueError(
+                f"split percentages must sum to 100, got {total:g} over "
+                f"{list(arms)}"
+            )
+
+    def _rebuild(self, arms: dict[str, float]) -> None:
+        # caller holds self._lock; retained arms keep their positions so
+        # cumulative boundaries — and therefore key assignments outside
+        # the shifted windows — stay put
+        self._validate(arms)
+        order = [a for a in self._order if a in arms]
+        order += [a for a in arms if a not in order]
+        bounds, cum = [], 0.0
+        for name in order:
+            cum += arms[name]
+            bounds.append(min(SPACE, int(round(cum / 100.0 * SPACE))))
+        bounds[-1] = SPACE  # rounding must never strand the top of space
+        self._order, self._percent, self._bounds = order, dict(arms), bounds
+
+    def arm(self, key: str) -> str:
+        """The arm ``key`` lands on — stable across restarts (pure hash),
+        minimal-move across re-splits (cumulative boundaries)."""
+        p = split_point(key)
+        with self._lock:
+            return self._order[bisect.bisect_right(self._bounds, p)]
+
+    def arms(self) -> dict[str, float]:
+        with self._lock:
+            return {a: self._percent[a] for a in self._order}
+
+    def set_percentages(self, arms: dict[str, float]) -> dict[str, float]:
+        """Re-split live traffic; returns the new arms.  Only keys whose
+        split point sits in a shifted boundary window change arms — the
+        minimal re-assignment for the declared change."""
+        with self._lock:
+            self._rebuild(dict(arms))
+            return {a: self._percent[a] for a in self._order}

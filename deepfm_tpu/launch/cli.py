@@ -107,6 +107,14 @@ def build_parser() -> argparse.ArgumentParser:
              "(0 = the servable's funnel.json default)",
     )
     p.add_argument(
+        "--serve_tenants",
+        help="task_type=serve with --serve_groups: multi-tenant fleet "
+             "bindings as JSON (deepfm_tpu/fleet) — "
+             '[{"name","source","split_percent","shadow_of"}...]; N '
+             "variants share one pool's executables, the router splits "
+             "traffic hash-stably and runs shadow challengers",
+    )
+    p.add_argument(
         "--set",
         action="append",
         default=[],
@@ -143,6 +151,7 @@ _FLAG_MAP = {
     "serve_group_mp": ("run", "serve_group_model_parallel"),
     "funnel_top_k": ("run", "funnel_top_k"),
     "funnel_return_n": ("run", "funnel_return_n"),
+    "serve_tenants": ("fleet", "tenants"),
 }
 
 

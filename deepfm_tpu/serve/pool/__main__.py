@@ -25,9 +25,24 @@ topology gives every member process its own virtual device set.
 from __future__ import annotations
 
 import argparse
+import json
 import subprocess
 import sys
 import threading
+
+
+def _load_tenants(arg: str):
+    """Parse ``--tenants``: inline JSON, or ``@path`` to a JSON file.
+    Returns validated TenantSpecs (deepfm_tpu/fleet)."""
+    from ...fleet.registry import parse_tenants
+
+    if not arg:
+        return ()
+    text = arg
+    if arg.startswith("@"):
+        with open(arg[1:]) as f:
+            text = f.read()
+    return parse_tenants(text)
 
 
 def _member_argv(args, group: str, index: int, port: int) -> list[str]:
@@ -43,6 +58,8 @@ def _member_argv(args, group: str, index: int, port: int) -> list[str]:
         argv += ["--exchange", args.exchange]
     if args.reload_url:
         argv += ["--reload-url", args.reload_url]
+    if args.tenants:
+        argv += ["--tenants", args.tenants]
     if args.funnel_top_k:
         argv += ["--funnel-top-k", str(args.funnel_top_k)]
     if args.funnel_return_n:
@@ -119,6 +136,7 @@ def _run_member(args) -> int:
         source=args.reload_url or None,
         funnel_top_k=args.funnel_top_k,
         funnel_return_n=args.funnel_return_n,
+        tenants=_load_tenants(args.tenants) or None,
     )
     return 0
 
@@ -150,6 +168,20 @@ def main(argv: list[str] | None = None) -> int:
                     help="publish root: each group gets a group-atomic "
                          "swap coordinator polling it")
     ap.add_argument("--reload-interval", type=float, default=2.0)
+    ap.add_argument(
+        "--tenants", default="",
+        help="multi-tenant fleet (deepfm_tpu/fleet): inline JSON or "
+             "@file — [{\"name\", \"source\", \"split_percent\", "
+             "\"shadow_of\"}...].  Members serve every tenant from one "
+             "executable set; the router splits traffic hash-stably and "
+             "runs shadow challengers off the response path; each "
+             "(group, tenant) gets its own group-atomic swap coordinator",
+    )
+    ap.add_argument("--shadow-sample", type=float, default=100.0,
+                    help="percent of the incumbent's stream the shadow "
+                         "challenger re-scores (hash-stable per key)")
+    ap.add_argument("--shadow-queue", type=int, default=128,
+                    help="bounded shadow queue depth; overflow sheds")
     ap.add_argument("--funnel-top-k", type=int, default=0,
                     help="funnel servables: candidates retrieved per user "
                          "(0 = the servable's funnel.json default)")
@@ -215,8 +247,22 @@ def main(argv: list[str] | None = None) -> int:
     print(f"pool: {args.groups} shard-group(s) at "
           f"{ {g: u[0] for g, u in urls.items()} }", file=sys.stderr)
 
+    tenant_specs = _load_tenants(args.tenants)
     swappers = []
-    if args.reload_url:
+    if tenant_specs:
+        # one group-atomic coordinator per (group, tenant-with-a-source):
+        # each polls ITS tenant's manifest stream and converges only that
+        # tenant's per-member slots
+        from .swap import GroupSwapper
+
+        for g in group_names:
+            for spec in tenant_specs:
+                if spec.source:
+                    swappers.append(GroupSwapper(
+                        urls[g], spec.source, group=g, tenant=spec.name,
+                        interval_secs=args.reload_interval,
+                    ).start())
+    elif args.reload_url:
         from .swap import GroupSwapper
 
         for g in group_names:
@@ -230,11 +276,37 @@ def main(argv: list[str] | None = None) -> int:
             from .router import Router, make_router_handler
             from ..server import ScoringHTTPServer
 
+            split = shadow = None
+            registry = None
+            if tenant_specs:
+                from ...fleet.registry import TenantRegistry
+                from ...fleet.shadow import ShadowScorer
+                from ...obs.metrics import MetricsRegistry
+
+                reg = TenantRegistry(tenant_specs)
+                split = reg.split()
+                # one registry for router + shadows so GET /metrics on
+                # the router shows every challenger's divergence
+                # histogram alongside routing
+                registry = MetricsRegistry()
+                # EVERY configured challenger scores its incumbent's
+                # stream — a validated-but-unwired shadow would read as
+                # "no divergence" when it means "no measurement"
+                shadow = [
+                    ShadowScorer(
+                        challenger, incumbent,
+                        sample_percent=args.shadow_sample,
+                        queue_depth=args.shadow_queue,
+                        registry=registry,
+                    )
+                    for challenger, incumbent in reg.shadow_pairs()
+                ]
             router = Router(
                 urls, model_name=args.model_name,
                 retry_limit=args.retry_limit,
                 eject_after=args.eject_after,
                 probe_interval_secs=args.health_interval,
+                split=split, shadow=shadow, registry=registry,
             ).start()
             httpd = ScoringHTTPServer(
                 (args.host, args.port), make_router_handler(router)

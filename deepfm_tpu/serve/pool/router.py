@@ -28,11 +28,27 @@ run in the supervisor process (serve/pool/__main__.py) or any sidecar.
   to it via ``X-Pinned-Generation``.  A member mid-swap answers 409 (a
   skew abort, counted) instead of scoring; the router re-reads the
   generation and retries — so a client can never observe a response
-  scored by mixed-version shards.
+  scored by mixed-version shards.  With a multi-tenant fleet the pin is
+  keyed by (group, TENANT) — generations are per tenant, so tenant A
+  mid-swap costs A a re-pin while B's pins stay valid.
+* **Traffic splitting** (deepfm_tpu/fleet): with a :class:`TrafficSplit`
+  attached, each request's tenant is either the explicit ``X-Tenant``
+  header or the hash-stable split arm of its routing key — a key lands
+  on the same arm across requests, router restarts and routers (the arm
+  is a pure function of key + percentages, fleet/split.py), and a
+  re-split moves only the boundary windows that shifted.  The chosen
+  tenant rides the forward as ``X-Tenant``.
+* **Shadow scoring** (fleet/shadow.py): with a shadow attached, a
+  hash-stable sample of the incumbent tenant's answered requests is
+  offered to the challenger OFF the response path — bounded queue,
+  sheds under load, never adds latency; only the incumbent's answer was
+  returned.  Score-divergence percentiles land in the registry
+  (``deepfm_shadow_divergence``).
 * **Metrics**: ``GET /v1/metrics`` aggregates per-group p50/p95/p99
   (router-measured, sliding window), requests/retries/skew-aborts/
-  ejections/re-admissions, and each group's exchange wire-bytes estimate
-  (cached from readiness probes).
+  ejections/re-admissions, each group's exchange wire-bytes estimate
+  (cached from readiness probes), and — with a fleet — a ``tenants``
+  section (per-tenant requests/latency/split share + shadow stats).
 """
 
 from __future__ import annotations
@@ -135,6 +151,8 @@ class Router:
         request_timeout_secs: float = 60.0,
         registry: MetricsRegistry | None = None,
         tracer: Tracer | None = None,
+        split=None,
+        shadow=None,
     ):
         if not groups:
             raise ValueError("router needs at least one shard-group")
@@ -149,7 +167,11 @@ class Router:
         self._probe_interval = float(probe_interval_secs)
         self._timeout = float(request_timeout_secs)
         self._lock = threading.Lock()
-        self._generation: dict[str, int] = {}
+        # generation pins keyed (group, tenant); tenant None is the
+        # legacy tenant-less pin (single-tenant members).  Per-tenant
+        # keys are learned from readiness probes' ``tenants`` map and
+        # from member responses/409s
+        self._generation: dict[tuple[str, str | None], int] = {}
         # all counters/latency live in the shared obs registry
         # (obs/metrics.py): /v1/metrics re-renders from it unchanged and
         # GET /metrics scrapes it directly
@@ -184,6 +206,39 @@ class Router:
             "router-measured member latency", labels=("group",))
         self._group_requests = {g: group_requests.labels(g) for g in groups}
         self._windows = {g: latency.labels(g) for g in groups}
+        # multi-tenant fleet (deepfm_tpu/fleet): the hash-stable split
+        # picks each request's tenant (unless X-Tenant names one) and the
+        # shadow(s) re-score a sampled slice of their incumbent's stream
+        # off the response path.  Both optional; a split-less router is
+        # the legacy single-tenant front unchanged.  ``shadow`` accepts
+        # one ShadowScorer or a sequence — every configured challenger
+        # gets its samples, not just the first.
+        self._split = split
+        self._shadows = ([] if shadow is None
+                         else list(shadow) if isinstance(shadow, (list,
+                                                                  tuple))
+                         else [shadow])
+        for sh in self._shadows:
+            # each challenger re-scores through the same routing
+            # machinery, addressed to ITSELF, with re-offering disabled
+            sh.bind(lambda body, _c=sh.challenger: self.handle_predict(
+                body, tenant=_c, _offer_shadow=False))
+        # tenant label cardinality is BOUNDED: only names the fleet
+        # actually serves (split arms, shadow pairs, tenants learned from
+        # member readiness probes) get metric children — an arbitrary
+        # client X-Tenant string must not grow the registry or the
+        # /v1/metrics payload without bound
+        self._known_tenants: set[str] = set()
+        if split is not None:
+            self._known_tenants.update(split.arms())
+        for sh in self._shadows:
+            self._known_tenants.update((sh.challenger, sh.incumbent))
+        self._tenant_requests = r.counter(
+            "deepfm_router_tenant_requests_total",
+            "requests routed per tenant", labels=("tenant",))
+        self._tenant_latency = r.histogram(
+            "deepfm_router_tenant_latency_seconds",
+            "router-measured latency per tenant", labels=("tenant",))
         self._stop = threading.Event()
         self._prober: threading.Thread | None = None
 
@@ -241,7 +296,15 @@ class Router:
                                       url=m.url)
                 m.healthy, m.fails, m.doc = True, 0, doc
                 if "group_generation" in doc:
-                    self._generation[group] = int(doc["group_generation"])
+                    self._generation[(group, None)] = int(
+                        doc["group_generation"]
+                    )
+                for t, td in (doc.get("tenants") or {}).items():
+                    self._known_tenants.add(t)
+                    if "generation" in td:
+                        self._generation[(group, t)] = int(
+                            td["generation"]
+                        )
             else:
                 m.fails += 1
                 if m.healthy and m.fails >= self._eject_after:
@@ -268,13 +331,40 @@ class Router:
                 target=self._probe_loop, daemon=True, name="router-prober"
             )
             self._prober.start()
+        for sh in self._shadows:
+            sh.start()
         return self
 
     def close(self) -> None:
         self._stop.set()
+        for sh in self._shadows:
+            sh.stop()
         if self._prober is not None:
             self._prober.join(timeout=10)
             self._prober = None
+
+    # -- fleet control plane ------------------------------------------------
+    def update_split(self, percentages: dict[str, float]) -> dict:
+        """Re-split live traffic across the tenant arms.  Hash-stable
+        minimal movement (fleet/split.py): only keys in the shifted
+        boundary windows change arms.  Recorded to the flight timeline —
+        a fleet incident shows WHEN the split moved."""
+        if self._split is None:
+            raise ValueError("router has no traffic split configured")
+        # arm names must be tenants the fleet actually serves: a typo'd
+        # re-split would hash that share of live keys onto an arm every
+        # member 400s — refuse the operation, not the traffic
+        unknown = sorted(set(percentages) - self._known_tenants)
+        if unknown:
+            raise ValueError(
+                f"unknown tenant arm(s) {unknown}; the fleet serves "
+                f"{sorted(self._known_tenants)}"
+            )
+        before = self._split.arms()
+        after = self._split.set_percentages(percentages)
+        obs_flight.record("split_change", subsystem="fleet",
+                          before=before, after=after)
+        return after
 
     # -- routing ------------------------------------------------------------
     @staticmethod
@@ -311,19 +401,33 @@ class Router:
         return head + [g for g in healthy if g not in head]
 
     def handle_predict(self, body: dict,
-                       path: str | None = None) -> tuple[int, dict]:
+                       path: str | None = None,
+                       tenant: str | None = None,
+                       _offer_shadow: bool = True) -> tuple[int, dict]:
         """Route one predict (or funnel recommend — ``path`` overrides
         the default ``:predict`` member route; same pinning, ejection and
         retry discipline); returns ``(http_status, response_doc)``.  The
         member's response document passes through untouched (it already
         carries predictions — or the funnel's items + index_version —
-        model_version, shard_group and group_generation) plus a
-        ``router`` attribution section."""
+        model_version, shard_group, tenant and group_generation) plus a
+        ``router`` attribution section.
+
+        ``tenant`` is the explicit X-Tenant selection; with none and a
+        split attached, the request's hash-stable split arm decides.
+        ``_offer_shadow=False`` marks the shadow worker's own re-scores
+        (a challenger score must never re-offer itself)."""
         target = path or f"/v1/models/{self.model_name}:predict"
         key = self.request_key(body)
+        if tenant is None and self._split is not None:
+            tenant = self._split.arm(key)
         rows = len(body.get("instances", []))
         plan = self._plan(key)
         self._c_requests.inc()
+        if tenant is not None and tenant in self._known_tenants:
+            # known tenants only: a client-invented X-Tenant string is
+            # forwarded (the member 400s it) but never mints a metric
+            # child — label cardinality stays bounded by the fleet config
+            self._tenant_requests.labels(tenant).inc()
         # the request's trace context (set by the router handler): every
         # forward attempt becomes a span, and the SAME trace id rides the
         # propagation headers across retries — including the 409 re-pin
@@ -348,8 +452,10 @@ class Router:
                 attempts += 1
                 if attempts > 1:
                     self._c_retries.inc()
-                gen = self._generation.get(group)
+                gen = self._generation.get((group, tenant))
                 headers = {"Content-Type": "application/json"}
+                if tenant is not None:
+                    headers["X-Tenant"] = tenant
                 if gen is not None:
                     headers["X-Pinned-Generation"] = str(gen)
                 if tctx is not None:
@@ -365,19 +471,44 @@ class Router:
                         req, timeout=self._timeout
                     ) as r:
                         doc = json.load(r)
-                    self._windows[group].observe(time.perf_counter() - t0)
+                    dt = time.perf_counter() - t0
+                    self._windows[group].observe(dt)
                     self._group_requests[group].inc()
+                    if tenant is not None:
+                        if tenant in self._known_tenants:
+                            self._tenant_latency.labels(tenant).observe(
+                                dt)
                     with self._lock:
                         if "group_generation" in doc:
-                            self._generation[group] = int(
+                            self._generation[(group, tenant)] = int(
                                 doc["group_generation"]
                             )
                     if tctx is not None:
+                        span_attrs = {"group": group, "attempt": attempts,
+                                      "status": 200}
+                        if tenant is not None:
+                            span_attrs["tenant"] = tenant
                         tctx.add_span(
                             "router.forward", t0, time.perf_counter(),
-                            group=group, attempt=attempts, status=200,
+                            **span_attrs,
                         )
                     doc["router"] = {"group": group, "attempts": attempts}
+                    if tenant is not None:
+                        doc["router"]["tenant"] = tenant
+                    # shadow the incumbent's answered stream: a
+                    # hash-stable sample is re-scored by each challenger
+                    # off this path (bounded queue, sheds under load);
+                    # the response below is already the incumbent's and
+                    # never waits on it.  Gate on the tenant the member
+                    # REPORTS scoring — a split-less fleet routes
+                    # unkeyed traffic as tenant None, but the member
+                    # still scored its default tenant, and that default
+                    # may be a challenger's incumbent
+                    scored_by = doc.get("tenant", tenant)
+                    if _offer_shadow and "predictions" in doc:
+                        for sh in self._shadows:
+                            if scored_by == sh.incumbent:
+                                sh.offer(key, body, doc["predictions"])
                     return 200, doc
                 except urllib.error.HTTPError as e:
                     try:
@@ -391,11 +522,14 @@ class Router:
                         )
                     if e.code == 409:
                         # generation skew: learn the member's live
-                        # generation and retry once, same group
+                        # generation FOR THIS TENANT and retry once,
+                        # same group (the 409 carries the tenant whose
+                        # pin went stale — tenant A's swap never
+                        # invalidates B's pins)
                         self._c_skew.inc()
                         with self._lock:
                             if "group_generation" in err:
-                                self._generation[group] = int(
+                                self._generation[(group, tenant)] = int(
                                     err["group_generation"]
                                 )
                         last_err = err
@@ -454,7 +588,12 @@ class Router:
                     "members": len(members),
                     "healthy_members": len(healthy),
                     "inflight_rows": sum(m.inflight for m in members),
-                    "generation": self._generation.get(g),
+                    "generation": self._generation.get((g, None)),
+                    "tenant_generations": {
+                        t: gen
+                        for (grp, t), gen in self._generation.items()
+                        if grp == g and t is not None
+                    },
                     "requests_total": int(self._group_requests[g].value),
                     "latency_ms": self._windows[g].snapshot(),
                     "exchange_wire_bytes_est": doc.get(
@@ -463,7 +602,7 @@ class Router:
                     "exchange": doc.get("exchange"),
                     "mesh": doc.get("mesh"),
                 }
-            return {
+            out = {
                 "router": {
                     "model": self.model_name,
                     "groups": len(self._members),
@@ -477,6 +616,29 @@ class Router:
                 },
                 "groups": groups,
             }
+        # the fleet view: per-tenant split share, routed requests and
+        # router-measured latency, plus the shadow challenger's stats
+        if self._split is not None or self._shadows:
+            tenants: dict[str, dict] = {}
+            arms = self._split.arms() if self._split is not None else {}
+            names = set(arms)
+            names.update(
+                k[0] for k in self._tenant_requests.children()
+            )
+            for t in sorted(names):
+                tenants[t] = {
+                    "split_percent": arms.get(t),
+                    "requests_total": int(
+                        self._tenant_requests.labels(t).value
+                    ),
+                    "latency_ms": self._tenant_latency.labels(
+                        t).snapshot(),
+                }
+            for sh in self._shadows:
+                tenants.setdefault(sh.challenger, {})[
+                    "shadow"] = sh.stats()
+            out["tenants"] = tenants
+        return out
 
 
 def make_router_handler(router: Router):
@@ -519,6 +681,17 @@ def make_router_handler(router: Router):
                 self._send(404, {"error": f"unknown path {self.path!r}"})
 
         def do_POST(self):  # noqa: N802
+            if self.path == "/admin:split":
+                # live re-split of tenant traffic (hash-stable minimal
+                # key movement, fleet/split.py); flight-recorded
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(length))
+                    arms = router.update_split(body["percentages"])
+                except (ValueError, KeyError, TypeError) as e:
+                    return self._send(400,
+                                      {"error": f"{type(e).__name__}: {e}"})
+                return self._send(200, {"arms": arms})
             if self.path not in (predict_path, recommend_path):
                 return self._send(404,
                                   {"error": f"unknown path {self.path!r}"})
@@ -542,6 +715,8 @@ def make_router_handler(router: Router):
                     body,
                     path=recommend_path if self.path == recommend_path
                     else None,
+                    # explicit tenant selection wins over the split arm
+                    tenant=self.headers.get("X-Tenant"),
                 )
                 self._send(code, doc)
             finally:

@@ -64,6 +64,7 @@ class GroupSwapper:
         source: str,
         *,
         group: str = "g0",
+        tenant: str | None = None,
         interval_secs: float = 2.0,
         admin_timeout_secs: float = 120.0,
         breaker: CircuitBreaker | None = None,
@@ -71,6 +72,13 @@ class GroupSwapper:
         if not members:
             raise ValueError("a shard-group needs at least one member")
         self.group = group
+        # one coordinator per (group, TENANT): each tenant's publish root
+        # is its own manifest stream, staged/committed onto that tenant's
+        # per-member slot only — tenant A's swap (or rollback) is
+        # structurally unable to touch tenant B's state (worker.py keys
+        # generations and payloads by tenant).  None = the legacy
+        # tenant-less protocol against single-tenant members.
+        self.tenant = tenant
         self._members = list(members)
         self._source = source
         self._interval = float(interval_secs)
@@ -95,6 +103,8 @@ class GroupSwapper:
 
     # -- member RPC ---------------------------------------------------------
     def _admin(self, member_url: str, verb: str, body: dict) -> dict:
+        if self.tenant is not None:
+            body = {**body, "tenant": self.tenant}
         req = urllib.request.Request(
             f"{member_url}/admin:{verb}",
             data=json.dumps(body).encode(),
@@ -198,9 +208,20 @@ class GroupSwapper:
                     doc = json.load(r)
             except (urllib.error.URLError, OSError, ValueError):
                 continue  # down or not ready: the next poll retries
-            gen = int(doc.get("group_generation", -1))
-            if (int(doc.get("model_version", -1)) == self.version
-                    and gen == self.generation):
+            if self.tenant is not None:
+                # per-tenant repair reads the readiness doc's tenants map
+                # (worker.readiness): a respawned member restarts EVERY
+                # tenant at generation 0, and each tenant's coordinator
+                # re-converges its own slice
+                td = (doc.get("tenants") or {}).get(self.tenant)
+                if td is None:
+                    continue  # member predates the tenant: next poll
+                gen = int(td.get("generation", -1))
+                ver = int(td.get("model_version", -1))
+            else:
+                gen = int(doc.get("group_generation", -1))
+                ver = int(doc.get("model_version", -1))
+            if ver == self.version and gen == self.generation:
                 continue
             if gen > self.generation:
                 # AHEAD of the group: a lost-response commit the failure
@@ -275,6 +296,7 @@ class GroupSwapper:
         with self._lock:
             return {
                 "group": self.group,
+                "tenant": self.tenant,
                 "members": len(self._members),
                 "generation": self.generation,
                 "version": self.version,

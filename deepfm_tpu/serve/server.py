@@ -385,19 +385,30 @@ def make_handler(scorer, model_name: str, reload_status=None,
     ``group_status`` (a zero-arg callable) turns on the shard-group pool
     surface (serve/pool/): its document —
 
-        {"shard_group": <str>, "group_generation": <int>,
+        {"shard_group": <str>, "tenant": <str>, "group_generation": <int>,
          "exchange": "alltoall"|"psum", "mesh": [dp, mp],
          "exchange_wire_bytes_est": <int>}
 
     — is served as the ``router`` section of ``/v1/metrics`` and merged
     into the ``/readyz`` document (the pool router reads generation +
     wire-bytes from readiness probes); every JSON ``:predict`` response
-    carries its ``shard_group`` and ``group_generation`` keys (so a
-    client sees WHICH group and generation scored it, alongside the
-    existing ``model_version``) without the rest of the gauge noise.  The
-    binary predict path stays a bare float array — group attribution
-    rides the ``X-Shard-Group`` / ``X-Group-Generation`` response headers
-    there.
+    carries its ``shard_group``, ``tenant`` and ``group_generation`` keys
+    (so a client sees WHICH group, tenant and generation scored it,
+    alongside the existing ``model_version``) without the rest of the
+    gauge noise.  ``tenant`` names the model variant that scored the
+    request (deepfm_tpu/fleet; a pool without a fleet config serves one
+    tenant, "default") and ``group_generation`` is that TENANT's
+    generation — generations are per tenant, so one tenant's swap never
+    relabels another's responses.  A JSON response whose tenant's
+    generation moved between admission and response assembly (a commit
+    or rollback landed mid-request) is refused with a 409 by the pool
+    member's attribution guard rather than sent under an ambiguous
+    label — the router re-pins and retries.  The binary predict path
+    stays a bare float array — group attribution rides the
+    ``X-Shard-Group`` / ``X-Tenant`` / ``X-Group-Generation`` response
+    headers there, and is at-most-one-behind across a swap window (the
+    headers are written before the body; exact provenance needs the
+    JSON path).
 
     ``reload_status`` (a zero-arg callable returning the HotSwapper status
     dict, serve/reload.py) turns on hot-reload observability: the status
@@ -486,6 +497,11 @@ def make_handler(scorer, model_name: str, reload_status=None,
                 if "funnel" not in snap and hasattr(
                         scorer, "funnel_snapshot"):
                     snap["funnel"] = scorer.funnel_snapshot()
+                # multi-tenant members (deepfm_tpu/fleet) publish the
+                # per-tenant generation/version/engine table — same hook
+                if "tenants" not in snap and hasattr(
+                        scorer, "tenants_snapshot"):
+                    snap["tenants"] = scorer.tenants_snapshot()
                 if group_status is not None:
                     snap["router"] = group_status()
                 self._send(200, snap)
@@ -543,7 +559,8 @@ def make_handler(scorer, model_name: str, reload_status=None,
             if group_status is not None:
                 gs = group_status()
                 doc.update({
-                    k: gs[k] for k in ("shard_group", "group_generation")
+                    k: gs[k]
+                    for k in ("shard_group", "tenant", "group_generation")
                     if k in gs
                 })
             self._send(200, doc)
@@ -603,6 +620,8 @@ def make_handler(scorer, model_name: str, reload_status=None,
             if group_status is not None:
                 gs = group_status()
                 self.send_header("X-Shard-Group", str(gs.get("shard_group")))
+                if "tenant" in gs:
+                    self.send_header("X-Tenant", str(gs.get("tenant")))
                 self.send_header(
                     "X-Group-Generation", str(gs.get("group_generation"))
                 )

@@ -792,6 +792,18 @@ def run_task(cfg: Config):
                 argv += ["--reload-url", cfg.run.serve_reload_url,
                          "--reload-interval",
                          str(cfg.run.serve_reload_interval_secs)]
+            if cfg.fleet.tenants:
+                # multi-tenant fleet (deepfm_tpu/fleet): members serve
+                # every tenant from one executable set; the router
+                # splits traffic and runs shadow challengers
+                import json as _json
+
+                argv += [
+                    "--tenants", _json.dumps(list(cfg.fleet.tenants)),
+                    "--shadow-sample",
+                    str(cfg.fleet.shadow_sample_percent),
+                    "--shadow-queue", str(cfg.fleet.shadow_queue_depth),
+                ]
             if cfg.run.funnel_top_k:
                 argv += ["--funnel-top-k", str(cfg.run.funnel_top_k)]
             if cfg.run.funnel_return_n:

@@ -38,7 +38,13 @@ fi
 # (audit_sharded_predict): the serving pool's shard-group predict must
 # lower with the all_to_all exchange (no dense row tensor outside the
 # fallback arm), cover every admissible per-group dispatch size with a
-# precompiled bucket, and keep group swaps jit cache hits — and the FUNNEL
+# precompiled bucket, and keep group swaps jit cache hits — and the
+# MULTITENANT contract (audit_multitenant): two distinct same-spec tenant
+# payloads must lower through ONE shard-group predict to IDENTICAL modules
+# with payload leaves as lowered parameters (deepfm_tpu/fleet: N model
+# variants on one pool cost N payloads and zero extra executables; a
+# spec-divergent tenant claiming shared executables or a tenant payload
+# baked as a constant fails the gate) — and the FUNNEL
 # contract (audit_funnel): the recommendation funnel's retrieve and
 # expand+rank executables must lower transfer-guard-clean with the index
 # as lowered parameters (a refresh is a cache hit), per-shard top-k
@@ -56,6 +62,7 @@ fi
 # different constant per retrace).
 # Seeded violations in tests/test_analysis.py (smuggled transfer,
 # dense-row leak, off-bucket/indivisible shape, baked mixed-generation
+# payload, spec-divergent tenants claiming one executable, baked tenant
 # payload, full-corpus score gather, baked index, reshard host round-trip,
 # baked reshard table, host timer closed over a traced value, registry
 # call inside a jitted fn) prove each contract actually catches its

@@ -1239,6 +1239,71 @@ class TestShardedPredictContract:
                    and "baked" in f.message for f in findings), findings
 
 
+class TestMultitenantContract:
+    """The fleet's executable-sharing trace contract
+    (trace_audit.audit_multitenant, wired into scripts/check.sh via
+    run_trace_audit): two distinct same-spec tenant payloads lower
+    through ONE shard-group predict to identical modules with payload
+    leaves as parameters."""
+
+    def test_real_fleet_holds_the_contract(self):
+        from deepfm_tpu.analysis.trace_audit import audit_multitenant
+
+        findings = audit_multitenant()
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_seeded_spec_divergent_tenants_caught(self):
+        """A tenant whose model spec diverges (wider embeddings) cannot
+        share the pool's executables: the audit convicts the sharing
+        claim and NAMES the diverging field — the same field the config
+        gate (core.config.EXECUTABLE_SPEC_FIELDS) refuses at load."""
+        from deepfm_tpu.analysis.trace_audit import audit_multitenant
+
+        findings = audit_multitenant(
+            tenant_models=[{}, {"embedding_size": 64}]
+        )
+        assert any(
+            f.rule == "trace-recompile"
+            and "spec-divergent" in f.message
+            and "embedding_size" in f.message
+            for f in findings
+        ), findings
+
+    def test_seeded_baked_tenant_payload_caught(self):
+        """A tenant payload compiled in as constants is the per-tenant-
+        module regression: every tenant swap would build a NEW
+        executable.  The leaf-count discriminator convicts it."""
+        import jax
+
+        from deepfm_tpu.analysis.trace_audit import audit_multitenant
+        from deepfm_tpu.models.base import get_model
+        from deepfm_tpu.serve.pool.sharded import (
+            build_sharded_predict_with,
+        )
+
+        def baked_builder(ctx):
+            real = build_sharded_predict_with(ctx)
+            model = get_model(ctx.cfg.model)
+            params, mstate = model.init(
+                jax.random.PRNGKey(0), ctx.cfg.model
+            )
+            concrete = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(x, s),
+                {"params": params, "model_state": mstate},
+                ctx.payload_shardings,
+            )
+
+            @jax.jit
+            def predict_baked(feat_ids, feat_vals):
+                return real(concrete, feat_ids, feat_vals)
+
+            return predict_baked
+
+        findings = audit_multitenant(predict_builder=baked_builder)
+        assert any(f.rule == "trace-recompile"
+                   and "baked" in f.message for f in findings), findings
+
+
 class TestFunnelContract:
     """The recommendation funnel's trace contract
     (trace_audit.audit_funnel, wired into scripts/check.sh via

@@ -1,0 +1,338 @@
+"""Multi-tenant fleet control plane (deepfm_tpu/fleet): hash-stable
+traffic splitting (uniformity, restart stability, minimal-movement
+re-split), tenant registry validation + spec-compatibility, shadow
+scorer queue semantics, and the fleet config gates."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deepfm_tpu.core.config import (
+    Config,
+    tenant_spec_divergence,
+    validate_tenant_entries,
+)
+from deepfm_tpu.fleet.registry import TenantRegistry, TenantSpec, parse_tenants
+from deepfm_tpu.fleet.shadow import ShadowScorer
+from deepfm_tpu.fleet.split import SPACE, TrafficSplit, sampled, split_point
+
+KEYS_10K = [f"user-{i}" for i in range(10_000)]
+
+
+# --------------------------------------------------------------------------
+# hash-stable splitting
+
+
+def _chi_square(counts: dict[str, int], expected: dict[str, float]) -> float:
+    return sum(
+        (counts.get(a, 0) - e) ** 2 / e for a, e in expected.items()
+    )
+
+
+@pytest.mark.parametrize("arms", [
+    {"a": 90.0, "b": 10.0},
+    {"a": 50.0, "b": 50.0},
+])
+def test_split_uniformity_chi_square_10k_keys(arms):
+    """Arm shares over 10k keys match the declared percentages: the
+    chi-square statistic against the expected counts stays under the
+    df=1, p=0.01 critical value (6.63) — md5 points are uniform, so the
+    split is exact, not approximately fair."""
+    split = TrafficSplit(dict(arms))
+    counts: dict[str, int] = {}
+    for k in KEYS_10K:
+        counts[split.arm(k)] = counts.get(split.arm(k), 0) + 1
+    expected = {a: p / 100.0 * len(KEYS_10K) for a, p in arms.items()}
+    stat = _chi_square(counts, expected)
+    assert stat < 6.63, (counts, stat)
+
+
+def test_same_key_same_arm_across_router_restart():
+    """The arm is a pure function of (key, percentages): a freshly
+    constructed split — a restarted router, a second router — agrees on
+    EVERY key.  No state, nothing to lose."""
+    arms = {"prod": 75.0, "exp": 25.0}
+    s1 = TrafficSplit(dict(arms))
+    before = {k: s1.arm(k) for k in KEYS_10K}
+    s2 = TrafficSplit(dict(arms))   # the restart
+    assert all(s2.arm(k) == before[k] for k in KEYS_10K)
+    # and split_point itself is stable and in-range
+    pts = [split_point(k) for k in KEYS_10K[:100]]
+    assert pts == [split_point(k) for k in KEYS_10K[:100]]
+    assert all(0 <= p < SPACE for p in pts)
+
+
+def test_resplit_moves_only_the_minimal_key_range():
+    """Re-splitting 90/10 -> 50/50 moves ONLY keys in the shifted
+    boundary window — every moved key moves a->b (the shrinking arm
+    sheds, the growing arm never gives any back), the moved share is the
+    declared delta, and every other key keeps its arm (the ring-churn
+    discipline in percentage space)."""
+    split = TrafficSplit({"a": 90.0, "b": 10.0})
+    before = {k: split.arm(k) for k in KEYS_10K}
+    split.set_percentages({"a": 50.0, "b": 50.0})
+    moved_ab = moved_ba = kept = 0
+    for k in KEYS_10K:
+        after = split.arm(k)
+        if after == before[k]:
+            kept += 1
+        elif before[k] == "a" and after == "b":
+            moved_ab += 1
+        else:
+            moved_ba += 1
+    assert moved_ba == 0, "a key moved AGAINST the boundary shift"
+    # the declared delta is 40% of traffic; allow sampling noise
+    assert abs(moved_ab / len(KEYS_10K) - 0.40) < 0.02
+    assert kept + moved_ab == len(KEYS_10K)
+    # moving BACK restores the original assignment exactly (pure hash)
+    split.set_percentages({"a": 90.0, "b": 10.0})
+    assert all(split.arm(k) == before[k] for k in KEYS_10K)
+
+
+def test_split_validation():
+    with pytest.raises(ValueError, match="sum to 100"):
+        TrafficSplit({"a": 60.0, "b": 20.0})
+    with pytest.raises(ValueError, match=">= 0"):
+        TrafficSplit({"a": 110.0, "b": -10.0})
+    with pytest.raises(ValueError, match="at least one arm"):
+        TrafficSplit({})
+    split = TrafficSplit({"a": 100.0})
+    with pytest.raises(ValueError, match="sum to 100"):
+        split.set_percentages({"a": 55.0})
+
+
+def test_shadow_sampling_is_hash_stable_and_independent():
+    picked = {k for k in KEYS_10K if sampled(k, 25.0)}
+    assert picked == {k for k in KEYS_10K if sampled(k, 25.0)}
+    assert abs(len(picked) / len(KEYS_10K) - 0.25) < 0.02
+    # independence from the split arms: the sampled slice must not be
+    # (anti)correlated with either arm, or divergence compares apples
+    # to a biased subpopulation
+    split = TrafficSplit({"a": 50.0, "b": 50.0})
+    in_a = sum(1 for k in picked if split.arm(k) == "a")
+    assert abs(in_a / len(picked) - 0.50) < 0.05
+
+
+# --------------------------------------------------------------------------
+# tenant registry
+
+
+def test_registry_validation_and_views():
+    reg = TenantRegistry([
+        {"name": "prod", "source": "/p", "split_percent": 90},
+        {"name": "exp", "source": "/e", "split_percent": 10},
+        {"name": "shadow", "source": "/s", "shadow_of": "prod"},
+    ])
+    assert reg.names() == ["prod", "exp", "shadow"]
+    assert [t.name for t in reg.serving()] == ["prod", "exp"]
+    assert reg.shadow_pairs() == [("shadow", "prod")]
+    split = reg.split()
+    assert split.arms() == {"prod": 90.0, "exp": 10.0}
+    # duplicate add refused; remove protects shadow references
+    with pytest.raises(ValueError, match="already registered"):
+        reg.add({"name": "prod", "source": "/p2"})
+    with pytest.raises(ValueError, match="shadowed by"):
+        reg.remove("prod")
+    reg.remove("shadow")
+    reg.remove("prod")
+    assert reg.names() == ["exp"]
+
+
+def test_registry_spec_compatibility_gate():
+    base = {"embedding_size": 32, "deep_layers": (8,), "l2_reg": 1e-4}
+    # executable-neutral overrides pass; executable-spec fields raise
+    TenantRegistry(
+        [{"name": "t", "source": "/t", "model": {"l2_reg": 0.01}}],
+        base_model=base,
+    )
+    with pytest.raises(ValueError, match="embedding_size"):
+        TenantRegistry(
+            [{"name": "t", "source": "/t",
+              "model": {"embedding_size": 64}}],
+            base_model=base,
+        )
+    # list-vs-tuple spelling of the SAME spec is not a divergence
+    assert tenant_spec_divergence(base, {"deep_layers": [8]}) == []
+
+
+def test_parse_tenants_accepts_json_dicts_and_specs():
+    entries = [{"name": "a", "source": "/a", "split_percent": 100}]
+    from_json = parse_tenants(json.dumps(entries))
+    from_dicts = parse_tenants(entries)
+    from_specs = parse_tenants(list(from_dicts))
+    assert from_json == from_dicts == from_specs
+    assert isinstance(from_json[0], TenantSpec)
+    assert from_json[0].split_percent == 100.0
+
+
+# --------------------------------------------------------------------------
+# shadow scorer
+
+
+def _mk_shadow(**kw):
+    return ShadowScorer("challenger", "incumbent", **kw)
+
+
+def test_shadow_scores_divergence_off_path():
+    seen = []
+
+    def forward(body):
+        seen.append(body)
+        return 200, {"predictions": [0.6, 0.6]}
+
+    sh = _mk_shadow(queue_depth=16).bind(forward).start()
+    try:
+        assert sh.offer("k1", {"instances": [1, 2]}, [0.5, 0.5])
+        sh.drain()
+        import time
+
+        deadline = time.monotonic() + 5
+        while sh.stats()["scored_total"] < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        st = sh.stats()
+        assert st["offered_total"] == 1 and st["scored_total"] == 1
+        assert st["shed_total"] == 0
+        assert abs(st["divergence"]["p50"] - 0.1) < 1e-6
+        assert seen  # the challenger actually saw the body
+    finally:
+        sh.stop()
+
+
+def test_shadow_sheds_on_full_queue_never_blocks():
+    sh = _mk_shadow(queue_depth=2)  # NOT started: queue can only fill
+    sh.bind(lambda body: (200, {"predictions": []}))
+    import time
+
+    t0 = time.perf_counter()
+    results = [sh.offer(f"k{i}", {}, [0.5]) for i in range(10)]
+    assert time.perf_counter() - t0 < 0.5  # put_nowait: never blocks
+    st = sh.stats()
+    assert st["shed_total"] == st["offered_total"] - 2 > 0
+    assert results.count(True) == 2
+    assert st["shed_rate"] == pytest.approx(
+        st["shed_total"] / st["offered_total"], abs=1e-3)
+
+
+def test_shadow_sampling_gate():
+    sh = _mk_shadow(sample_percent=0.0)
+    assert not sh.offer("k", {}, [0.5])
+    assert sh.stats()["offered_total"] == 0
+
+
+def test_shadow_errors_counted_not_raised():
+    sh = _mk_shadow(queue_depth=4).bind(
+        lambda body: (503, {"error": "down"})
+    ).start()
+    try:
+        sh.offer("k", {}, [0.5])
+        import time
+
+        deadline = time.monotonic() + 5
+        while sh.stats()["errors_total"] < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sh.stats()["errors_total"] == 1
+        assert sh.stats()["scored_total"] == 0
+    finally:
+        sh.stop()
+
+
+def test_shadow_refuses_self_shadow():
+    with pytest.raises(ValueError, match="shadow itself"):
+        ShadowScorer("t", "t")
+
+
+# --------------------------------------------------------------------------
+# config gates (core/config.py satellite)
+
+
+def test_config_duplicate_tenant_names_raise():
+    with pytest.raises(ValueError, match="duplicate fleet tenant"):
+        Config.from_dict({"fleet": {"tenants": [
+            {"name": "a", "source": "/1"}, {"name": "a", "source": "/2"},
+        ]}})
+
+
+def test_config_split_must_sum_to_100():
+    with pytest.raises(ValueError, match="sum to 100"):
+        Config.from_dict({"fleet": {"tenants": [
+            {"name": "a", "split_percent": 70},
+            {"name": "b", "split_percent": 20},
+        ]}})
+    # shadows take no split and are excluded from the sum
+    cfg = Config.from_dict({"fleet": {"tenants": [
+        {"name": "a", "split_percent": 70},
+        {"name": "b", "split_percent": 30},
+        {"name": "c", "shadow_of": "a"},
+    ]}})
+    assert len(cfg.fleet.tenants) == 3
+
+
+def test_config_spec_divergence_names_fields():
+    with pytest.raises(ValueError) as e:
+        Config.from_dict({"fleet": {"tenants": [
+            {"name": "a",
+             "model": {"embedding_size": 64, "deep_layers": [512],
+                       "l2_reg": 0.01}},
+        ]}})
+    # the DIFFERING executable-spec fields are named; the neutral one
+    # (l2_reg) is not
+    msg = str(e.value)
+    assert "deep_layers" in msg and "embedding_size" in msg
+    assert "l2_reg" not in msg
+
+
+def test_config_shadow_reference_and_split_gates():
+    with pytest.raises(ValueError, match="not a serving"):
+        Config.from_dict({"fleet": {"tenants": [
+            {"name": "a"}, {"name": "s", "shadow_of": "missing"},
+        ]}})
+    with pytest.raises(ValueError, match="cannot take live split"):
+        Config.from_dict({"fleet": {"tenants": [
+            {"name": "a", "split_percent": 100},
+            {"name": "s", "shadow_of": "a", "split_percent": 5},
+        ]}})
+    with pytest.raises(ValueError, match="unknown key"):
+        validate_tenant_entries([{"name": "a", "sauce": "/typo"}])
+
+
+def test_fleet_flag_reaches_config():
+    from deepfm_tpu.launch.cli import resolve_config
+
+    tenants = json.dumps([
+        {"name": "prod", "source": "/p", "split_percent": 100},
+    ])
+    cfg, _ = resolve_config([
+        "--task_type", "serve", "--serve_tenants", tenants, "--no_env",
+    ])
+    assert cfg.fleet.tenants[0]["name"] == "prod"
+    assert cfg.fleet.tenants[0]["split_percent"] == 100.0
+
+
+def test_shadow_divergence_distribution_sane():
+    """Statistical sanity on the divergence histogram: feeding known
+    gaps recovers their percentiles (the registry path end to end)."""
+    rng = np.random.default_rng(0)
+    gaps = rng.uniform(0.0, 0.2, 64)
+    calls = iter(gaps)
+
+    def forward(body):
+        return 200, {"predictions": [0.5 + next(calls)]}
+
+    sh = _mk_shadow(queue_depth=256).bind(forward).start()
+    try:
+        for i in range(64):
+            sh.offer(f"k{i}", {}, [0.5])
+        import time
+
+        deadline = time.monotonic() + 10
+        while sh.stats()["scored_total"] < 64 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        d = sh.stats()["divergence"]
+        assert d["count"] == 64
+        assert abs(d["p50"] - float(np.quantile(gaps, 0.5))) < 0.02
+    finally:
+        sh.stop()

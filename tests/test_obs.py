@@ -208,10 +208,15 @@ class TestPinnedSchemas:
         g = snap["groups"]["g0"]
         assert set(g) == {
             "members", "healthy_members", "inflight_rows", "generation",
-            "requests_total", "latency_ms", "exchange_wire_bytes_est",
-            "exchange", "mesh",
+            "tenant_generations", "requests_total", "latency_ms",
+            "exchange_wire_bytes_est", "exchange", "mesh",
         }
         assert g["latency_ms"] == {"count": 0}
+        # per-tenant generation pins (deepfm_tpu/fleet): empty on a
+        # fleet-less router — the legacy sections above are UNCHANGED
+        assert g["tenant_generations"] == {}
+        # and a fleet-less router serves no "tenants" section at all
+        assert "tenants" not in snap
 
 
 # ------------------------------------------------------------------ tracing

@@ -280,14 +280,14 @@ def test_router_skew_abort_repins_and_retries():
                     probe_interval_secs=30)
     try:
         router.probe_once()
-        assert router._generation["g0"] == 3
+        assert router._generation[("g0", None)] == 3
         a.generation = 4  # the group commits under the router
         code, doc = router.handle_predict({"instances": _instances(1)})
         assert code == 200
         assert doc["group_generation"] == 4
         snap = router.metrics_snapshot()["router"]
         assert snap["skew_aborts_total"] == 1
-        assert router._generation["g0"] == 4  # re-pinned from the abort
+        assert router._generation[("g0", None)] == 4  # re-pinned from the abort
     finally:
         router.close()
         a.close()
