@@ -410,6 +410,25 @@ def main() -> None:
 
         elastic_drill.main()
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--elastic-multihost":
+        # the multi-host elastic drill (benchmarks/elastic_multihost.py):
+        # the same [2,4]→[1,4]→[2,4] cycle under lease-fenced epoch
+        # consensus, with the MPMD trainer/publisher split across real
+        # processes, a scripted coordinator outage (frozen-topology
+        # training), and stale-token writers refused on both the commit
+        # and the publish path; emits docs/BENCH_ELASTIC_MULTIHOST.json
+        # and FAILS (exit 1) on any violation.  CPU virtual mesh by
+        # design — the drill measures the coordination layer, not chips.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        import elastic_multihost
+
+        elastic_multihost.main()
+        return
     if len(sys.argv) > 2 and sys.argv[1] == "--variant":
         # child: platform was resolved by the parent and passed via env
         run_variant(sys.argv[2])

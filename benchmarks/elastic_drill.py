@@ -32,9 +32,12 @@ import os
 import sys
 import threading
 import time
-import urllib.request
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import _pool_util as pu
 
 FEATURE, FIELD = 64, 5
 LOSS_TOLERANCE = 5e-3
@@ -116,15 +119,6 @@ class _LossRecorder:
             self._fired += 1
 
 
-def _post(url, payload, timeout=60):
-    req = urllib.request.Request(
-        url, data=json.dumps(payload).encode(),
-        headers={"Content-Type": "application/json"},
-    )
-    with urllib.request.urlopen(req, timeout=timeout) as r:
-        return json.load(r)
-
-
 def run_drill(
     root: str,
     *,
@@ -160,83 +154,32 @@ def run_drill(
     # shared XLA:CPU thread pool in-process), router in the supervisor,
     # one GroupSwapper polling the drill's publish root -------------------
     serving: dict = {"enabled": bool(serve)}
-    pool_proc = None
+    pool: pu.PoolProcess | None = None
     clients: list[threading.Thread] = []
     results: list[tuple] = []
     errors: list[str] = []
     stop_clients = threading.Event()
-    router_url = None
     if serve:
-        import socket
-        import subprocess
-
         base_servable = os.path.join(root, "servable")
         export_servable(cfg, create_train_state(cfg), base_servable)
+        pool = pu.PoolProcess(
+            base_servable, reload_url=cfg.run.servable_model_dir)
 
-        def _free_port():
-            s = socket.socket()
-            s.bind(("127.0.0.1", 0))
-            p = s.getsockname()[1]
-            s.close()
-            return p
+        def _instances(rng):
+            return [{
+                "feat_ids": rng.integers(0, FEATURE, FIELD).tolist(),
+                "feat_vals": rng.random(FIELD).round(4).tolist(),
+            }]
 
-        router_port, member_port = _free_port(), _free_port()
-        router_url = f"http://127.0.0.1:{router_port}"
-        env = dict(os.environ, JAX_PLATFORMS="cpu")
-        pool_proc = subprocess.Popen(
-            [sys.executable, "-m", "deepfm_tpu.serve.pool",
-             "--servable", base_servable, "--router",
-             "--groups", "1", "--group-dp", "1", "--group-mp", "2",
-             "--port", str(router_port),
-             "--member-port-base", str(member_port),
-             "--buckets", "4,8", "--health-interval", "0.2",
-             "--reload-url", cfg.run.servable_model_dir,
-             "--reload-interval", "0.3"],
-            env=env, stderr=subprocess.DEVNULL,
-        )
-
-        def _predict_once(timeout=20):
-            rng = np.random.default_rng(0)
-            return _post(
-                f"{router_url}/v1/models/deepfm:predict",
-                {"instances": [{
-                    "feat_ids": rng.integers(0, FEATURE, FIELD).tolist(),
-                    "feat_vals": rng.random(FIELD).round(4).tolist(),
-                }]},
-                timeout=timeout,
-            )
-
-        # readiness barrier: failures BEFORE the pool ever served are
-        # startup (compile) latency, not serving errors — the drill's
-        # claim is zero failures from ready through the whole shrink/grow
-        deadline = time.time() + 300
-        ready = False
-        while time.time() < deadline:
-            try:
-                _predict_once()
-                ready = True
-                break
-            except Exception:
-                time.sleep(0.5)
-        if not ready:
-            pool_proc.kill()
-            raise RuntimeError("serving pool never became ready")
+        pool.wait_ready(_instances(np.random.default_rng(0)))
         lock = threading.Lock()
 
         def client(seed):
             rng = np.random.default_rng(seed)
             while not stop_clients.is_set():
-                inst = [{
-                    "feat_ids": rng.integers(0, FEATURE, FIELD).tolist(),
-                    "feat_vals": rng.random(FIELD).round(4).tolist(),
-                }]
                 try:
-                    doc = _post(
-                        f"{router_url}/v1/models/deepfm:predict",
-                        {"key": f"k{rng.integers(0, 64)}",
-                         "instances": inst},
-                        timeout=60,
-                    )
+                    doc = pool.predict(_instances(rng),
+                                       key=f"k{rng.integers(0, 64)}")
                     with lock:
                         results.append((doc["group_generation"],
                                         doc["model_version"]))
@@ -250,24 +193,12 @@ def run_drill(
         for t in clients:
             t.start()
 
-    pool_stopped = False
-
     def _stop_pool():
         # idempotent teardown, also bound to the outer finally: a failed
         # training run must never leak the router/member process tree
         # (and its ports) into the rest of the session
-        nonlocal pool_stopped
-        if pool_proc is None or pool_stopped:
-            return
-        pool_stopped = True
-        stop_clients.set()
-        for t in clients:
-            t.join(timeout=60)
-        pool_proc.terminate()
-        try:
-            pool_proc.wait(timeout=60)
-        except Exception:
-            pool_proc.kill()
+        if pool is not None:
+            pool.stop(clients=clients, stop_clients=stop_clients)
 
     try:
         return _run_and_measure(
@@ -316,19 +247,7 @@ def _run_and_measure(
             time.sleep(0.3)
         stop_pool()
         seen = sorted(set(results))
-        # mixed-version detection from the responses alone: a committed
-        # history maps each group generation to exactly ONE version, and
-        # (generation, version) advance together — any generation scored
-        # under two versions, or any version regression as generations
-        # advance, is a mixed state no request may ever observe
-        by_gen: dict[int, set[int]] = {}
-        for g, v in seen:
-            by_gen.setdefault(g, set()).add(v)
-        mixed = [(g, sorted(vs)) for g, vs in sorted(by_gen.items())
-                 if len(vs) > 1]
-        ordered = [max(vs) for _, vs in sorted(by_gen.items())]
-        if ordered != sorted(ordered):
-            mixed.append(("version_regression", ordered))
+        mixed = pu.mixed_version_pairs(seen)
         serving.update({
             "predicts": len(results),
             "failed": len(errors),

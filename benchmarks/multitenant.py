@@ -58,6 +58,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import _bench_util as bu
+import _pool_util as pu
 
 V, F = 117_581, 39
 TENANTS = ("t0", "t1", "t2", "t3")
@@ -121,127 +122,8 @@ def _expected_scores(version_dir: str, instances) -> np.ndarray:
     return np.asarray(predict(ids, vals))
 
 
-def _connect(port: int):
-    import http.client
-    import socket as _socket
-
-    conn = http.client.HTTPConnection("127.0.0.1", port)
-    conn.connect()
-    conn.sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
-    return conn
 
 
-def _percentiles_ms(lat: list) -> dict:
-    lat = sorted(lat)
-    if not lat:
-        return {"p50_ms": None, "p99_ms": None}
-    pick = lambda q: round(1e3 * lat[int((len(lat) - 1) * q)], 3)  # noqa: E731
-    return {"p50_ms": pick(0.50), "p99_ms": pick(0.99)}
-
-
-def _closed_loop(port: int, body_fn, *, n_clients: int, per_client: int,
-                 headers=None, collect=None) -> dict:
-    """Closed-loop keep-alive clients against the router; ``body_fn(rng)``
-    builds each request body, ``collect`` (a list) receives
-    ``(tenant, latency, doc)`` per 200 response."""
-    lat: list[float] = []
-    errors: list[str] = []
-    lock = threading.Lock()
-    start = threading.Barrier(n_clients + 1)
-
-    def client(seed: int):
-        rng = np.random.default_rng(seed)
-        conn = _connect(port)
-        mine, mine_docs = [], []
-        try:
-            start.wait()
-            for _ in range(per_client):
-                body = json.dumps(body_fn(rng))
-                t1 = time.perf_counter()
-                conn.request("POST", "/v1/models/deepfm:predict", body,
-                             {"Content-Type": "application/json",
-                              **(headers or {})})
-                r = conn.getresponse()
-                payload = r.read()
-                dt = time.perf_counter() - t1
-                if r.status != 200:
-                    with lock:
-                        errors.append(f"{r.status}: {payload[:120]!r}")
-                    continue
-                mine.append(dt)
-                if collect is not None:
-                    doc = json.loads(payload)
-                    mine_docs.append((doc.get("tenant"), dt, doc))
-        except Exception as e:  # pragma: no cover - diagnostic
-            with lock:
-                errors.append(f"{type(e).__name__}: {e}")
-        finally:
-            conn.close()
-            with lock:
-                lat.extend(mine)
-                if collect is not None:
-                    collect.extend(mine_docs)
-
-    threads = [threading.Thread(target=client, args=(1000 + i,))
-               for i in range(n_clients)]
-    for t in threads:
-        t.start()
-    start.wait()
-    t0 = time.perf_counter()
-    for t in threads:
-        t.join()
-    dt = time.perf_counter() - t0
-    row = {"clients": n_clients, "requests": len(lat),
-           "requests_per_sec": round(len(lat) / dt, 1),
-           **_percentiles_ms(lat)}
-    if errors:
-        row["errors"] = errors[:3]
-        row["error_count"] = len(errors)
-    return row
-
-
-def _timed_window(port: int, body_fn, *, n_clients: int, secs: float,
-                  headers=None) -> float:
-    """Stop-driven window; returns requests/sec (the paired-window unit)."""
-    done = 0
-    lock = threading.Lock()
-    stop = threading.Event()
-    start = threading.Barrier(n_clients + 1)
-
-    def client(seed: int):
-        nonlocal done
-        rng = np.random.default_rng(seed)
-        conn = _connect(port)
-        mine = 0
-        try:
-            start.wait()
-            while not stop.is_set():
-                conn.request("POST", "/v1/models/deepfm:predict",
-                             json.dumps(body_fn(rng)),
-                             {"Content-Type": "application/json",
-                              **(headers or {})})
-                r = conn.getresponse()
-                r.read()
-                if r.status == 200:
-                    mine += 1
-        except Exception:  # pragma: no cover - window edge
-            pass
-        finally:
-            conn.close()
-            with lock:
-                done += mine
-
-    threads = [threading.Thread(target=client, args=(3000 + i,))
-               for i in range(n_clients)]
-    for t in threads:
-        t.start()
-    start.wait()
-    t0 = time.perf_counter()
-    time.sleep(secs)
-    stop.set()
-    for t in threads:
-        t.join()
-    return done / (time.perf_counter() - t0)
 
 
 def _start_pool(servable: str, *, tenants, buckets, max_wait_ms,
@@ -321,8 +203,8 @@ def main() -> dict:
             urls, retry_limit=1, probe_interval_secs=0.5)
         port = int(rurl.rsplit(":", 1)[1])
         try:
-            _closed_loop(port, body, n_clients=4, per_client=2)  # warm
-            base = _closed_loop(port, body, n_clients=args.concurrency,
+            pu.closed_loop(port, body, n_clients=4, per_client=2)  # warm
+            base = pu.closed_loop(port, body, n_clients=args.concurrency,
                                 per_client=args.per_client)
             base_row = {"layer": "baseline", "groups": 2,
                         "host_cpus": host_cpus, **base}
@@ -382,14 +264,14 @@ def main() -> dict:
 
             # per-tenant latency under the split, challenger shadowing t0
             collect: list = []
-            _closed_loop(port, body, n_clients=4, per_client=2)  # warm
-            mt = _closed_loop(port, body, n_clients=args.concurrency,
+            pu.closed_loop(port, body, n_clients=4, per_client=2)  # warm
+            mt = pu.closed_loop(port, body, n_clients=args.concurrency,
                               per_client=args.per_client, collect=collect)
             per_tenant = {}
             for t in TENANTS:
                 tl = [dt for (tt, dt, _) in collect if tt == t]
                 per_tenant[t] = {"requests": len(tl),
-                                 **_percentiles_ms(tl)}
+                                 **pu.percentiles_ms(tl)}
             shadow.drain()
             time.sleep(0.3)  # let the last dequeued item finish scoring
             mt_row = {
@@ -414,10 +296,10 @@ def main() -> dict:
             windows = {"off": [], "on": []}
             for _ in range(PAIRS):
                 shadow.set_sample_percent(0.0)
-                off = _timed_window(port, body, n_clients=8,
+                off = pu.timed_window(port, body, n_clients=8,
                                     secs=WINDOW_SECS, headers=t0_hdr)
                 shadow.set_sample_percent(100.0)
-                on = _timed_window(port, body, n_clients=8,
+                on = pu.timed_window(port, body, n_clients=8,
                                    secs=WINDOW_SECS, headers=t0_hdr)
                 windows["off"].append(round(off, 1))
                 windows["on"].append(round(on, 1))
@@ -428,10 +310,10 @@ def main() -> dict:
             # a 1-core host, absorbed by spare cores elsewhere)
             shadow.start()
             shadow.set_sample_percent(0.0)
-            act_off = _timed_window(port, body, n_clients=8,
+            act_off = pu.timed_window(port, body, n_clients=8,
                                     secs=WINDOW_SECS, headers=t0_hdr)
             shadow.set_sample_percent(100.0)
-            act_on = _timed_window(port, body, n_clients=8,
+            act_on = pu.timed_window(port, body, n_clients=8,
                                    secs=WINDOW_SECS, headers=t0_hdr)
             paired = {
                 "layer": "shadow_paired",
@@ -519,7 +401,7 @@ def _swap_drill(port, swappers, pubs, cfg, state, roots, expected,
 
     def client(seed: int):
         rng = np.random.default_rng(seed)
-        conn = _connect(port)
+        conn = pu.connect(port)
         try:
             while not stop.is_set():
                 body = json.dumps({

@@ -1475,6 +1475,66 @@ def audit_elastic(cfg=None, reshard_builder=None) -> list[Finding]:
                          "argument (checkpoint/reshard.jit_row_adapter)",
                     where=where, slug=f"elastic-{move}-{leaf}-baked",
                 ))
+    out.extend(_audit_consensus_merge(base, devs))
+    return out
+
+
+def _audit_consensus_merge(base, devs) -> list[Finding]:
+    """The multi-host half of the elastic contract (elastic/coord.py):
+    the registry-view merge that feeds the reshard planner must be
+    deterministic and participant-order-independent (two processes
+    deriving DIFFERENT consensus sets would build different meshes — the
+    exact disagreement the coordinator exists to prevent), and a plan
+    drawn on a consensus-merged shrink set must stay minimal exactly like
+    a locally-detected one (zero table bytes for a same-width shrink)."""
+    from ..core.config import MeshConfig
+    from ..elastic.coord import merge_views
+    from ..elastic.plan import plan_reshard
+    from ..parallel import build_mesh, make_context
+
+    where = "deepfm_tpu/elastic/coord.py"
+    out: list[Finding] = []
+    full = tuple(d.id for d in devs[:8])
+    lost = tuple(d.id for d in devs[:4])  # one participant lost a slice
+    views = {"p0": full, "p1": lost}
+    merged = merge_views(views)
+    swapped = merge_views({"p1": lost, "p0": full})
+    if merged != swapped:
+        out.append(_finding(
+            "trace-collective",
+            f"registry-view merge is participant-order-DEPENDENT: "
+            f"{merged} vs {swapped} for the same views — two processes "
+            f"would agree on different consensus device sets",
+            hint="merge_views must be a pure order-independent function "
+                 "of the views (elastic/coord.py)",
+            where=where, slug="elastic-merge-order-dependent",
+        ))
+    if set(merged) != set(full) & set(lost):
+        out.append(_finding(
+            "trace-collective",
+            f"registry-view merge is not the intersection: got {merged} "
+            f"from views {views} — a device one participant cannot "
+            f"address would enter the shared mesh",
+            where=where, slug="elastic-merge-not-intersection",
+        ))
+    by_id = {d.id: d for d in devs}
+    old_ctx = make_context(base, build_mesh(
+        MeshConfig(data_parallel=2, model_parallel=4),
+        devices=[by_id[i] for i in full],
+    ))
+    new_ctx = make_context(base, build_mesh(
+        MeshConfig(data_parallel=1, model_parallel=4),
+        devices=[by_id[i] for i in merged],
+    ))
+    plan = plan_reshard(old_ctx, new_ctx)
+    if plan.moved_bytes != 0:
+        out.append(_finding(
+            "trace-collective",
+            f"same-width shrink onto the CONSENSUS-merged device set "
+            f"plans {plan.moved_bytes} table bytes — the merge must not "
+            f"perturb plan minimality (surviving shards own their rows)",
+            where=where, slug="elastic-consensus-shrink-moves-bytes",
+        ))
     return out
 
 

@@ -224,6 +224,7 @@ def restore_resharded_payload(
         cursor_segment=jax.ShapeDtypeStruct((_CURSOR_BYTES,), jnp.uint8),
         cursor_len=jax.ShapeDtypeStruct((), jnp.int32),
         cursor_record=jax.ShapeDtypeStruct((), jnp.int64),
+        fence_token=jax.ShapeDtypeStruct((), jnp.int64),
     )
     repl = NamedSharding(ctx.mesh, P())
     shardings = OnlinePayload(
@@ -232,8 +233,27 @@ def restore_resharded_payload(
         cursor_segment=repl,
         cursor_len=repl,
         cursor_record=repl,
+        fence_token=repl,
     )
-    return _restore_resharded_tree(ckpt, target_shapes, shardings, step)
+    try:
+        return _restore_resharded_tree(ckpt, target_shapes, shardings, step)
+    except ReshardDataLossError:
+        raise  # deliberate refusal, never a format question
+    except Exception as e:
+        # pre-fencing commit (no fence_token leaf): retry with the legacy
+        # payload tree and upgrade to fence_token=0 (the unfenced marker)
+        from ..online.trainer import _LegacyOnlinePayload, _upgrade_legacy
+
+        try:
+            legacy = _restore_resharded_tree(
+                ckpt,
+                _LegacyOnlinePayload(*target_shapes[:5]),
+                _LegacyOnlinePayload(*shardings[:5]),
+                step,
+            )
+        except Exception:
+            raise e from None  # the original failure is the real story
+        return _upgrade_legacy(legacy)
 
 
 def _restore_resharded_tree(

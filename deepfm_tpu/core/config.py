@@ -432,6 +432,33 @@ class ElasticConfig:
     # fails (devices already gone) the last periodic commit is the resume
     # point — exactly-once either way, the failed window just replays
     drain_commit: bool = True
+    # -- multi-host composition (elastic/coord.py) --------------------------
+    # coordination service URL ("" = single-process: no leases, no
+    # consensus, PR-9 behavior).  With a coordinator, every training
+    # process holds a TTL lease, heartbeats its local registry view, and
+    # reshards only through the coordinator's two-phase drain barrier;
+    # commits and publishes carry the lease's fencing token, which the
+    # checkpoint root and publish root ENFORCE (a stale-token write is
+    # refused, not just discouraged).
+    coordinator_url: str = ""
+    # lease TTL: a process that misses heartbeats for this long is expired
+    # from consensus (its devices drop out, its fencing token goes stale)
+    lease_ttl_secs: float = 10.0
+    # heartbeat cadence (must leave headroom under the TTL; transitions
+    # and view changes heartbeat immediately regardless)
+    heartbeat_interval_secs: float = 1.0
+    # LiveDeviceRegistry debounce: consecutive anomalous polls required
+    # before a device-set change bumps the epoch (one transient device-
+    # query hiccup must not cost a full drain/commit/reshard cycle)
+    registry_debounce_polls: int = 2
+    # MPMD trainer/publisher split: the trainer only COMMITS payloads;
+    # a separate `--task_type publish` process tails the checkpoint root
+    # and publishes asynchronously, so a publish-store outage degrades
+    # freshness instead of stalling the train step
+    publisher_split: bool = False
+    # publisher process: cadence for polling the checkpoint root for
+    # newly committed payloads
+    publish_poll_secs: float = 0.5
 
     def __post_init__(self):
         if self.min_devices < 1:
@@ -442,6 +469,34 @@ class ElasticConfig:
             raise ValueError(
                 f"elastic.prefer_model_parallel must be >= 0 (0 = "
                 f"mesh.model_parallel), got {self.prefer_model_parallel}"
+            )
+        if self.lease_ttl_secs <= 0:
+            raise ValueError(
+                f"elastic.lease_ttl_secs must be > 0, got "
+                f"{self.lease_ttl_secs}"
+            )
+        if self.heartbeat_interval_secs <= 0:
+            raise ValueError(
+                f"elastic.heartbeat_interval_secs must be > 0, got "
+                f"{self.heartbeat_interval_secs}"
+            )
+        if self.heartbeat_interval_secs >= self.lease_ttl_secs / 2:
+            raise ValueError(
+                f"elastic.heartbeat_interval_secs="
+                f"{self.heartbeat_interval_secs} leaves no headroom under "
+                f"lease_ttl_secs={self.lease_ttl_secs}: one delayed "
+                f"heartbeat would expire the lease and self-fence the "
+                f"trainer — keep the interval under ttl/2"
+            )
+        if self.registry_debounce_polls < 1:
+            raise ValueError(
+                f"elastic.registry_debounce_polls must be >= 1, got "
+                f"{self.registry_debounce_polls}"
+            )
+        if self.publish_poll_secs <= 0:
+            raise ValueError(
+                f"elastic.publish_poll_secs must be > 0, got "
+                f"{self.publish_poll_secs}"
             )
 
 

@@ -2,14 +2,28 @@
 exactly-once resume and uninterrupted serving.
 
 * ``registry``   — device availability (virtual for tests/chaos drills,
-  ``jax.devices()`` liveness in production)
+  ``jax.devices()`` liveness in production — debounced)
 * ``plan``       — mesh choice policy + minimal-traffic redistribution
   planning (no gather-to-host; arxiv 2112.01075's frame)
 * ``controller`` — the ElasticTrainer lifecycle: detect → drain →
   commit → replan → reshard → resume → publish
+* ``coord``      — multi-host composition: TTL leases, registry-view
+  consensus, the two-phase reshard barrier, fencing tokens
+* ``mpmd``       — the trainer/publisher MPMD split: the publisher
+  program that tails committed payloads (``--task_type publish``)
 """
 
 from .controller import ElasticTrainer, run_elastic_train  # noqa: F401
+from .coord import (  # noqa: F401
+    CoordClient,
+    CoordinatedRegistry,
+    Coordinator,
+    Fence,
+    StaleFencingTokenError,
+    merge_views,
+    serve_coordinator,
+)
+from .mpmd import PayloadPublisher, run_publisher  # noqa: F401
 from .plan import (  # noqa: F401
     ReshardPlan,
     choose_mesh,

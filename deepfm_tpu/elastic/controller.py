@@ -34,6 +34,23 @@ instead keeps ONE process alive across topology changes:
    generation-pinned group swap stays a jit cache hit and ingests the
    post-shrink publish without a 409 storm.  Serving never observes the
    topology change.
+
+**Multi-host composition** (``elastic/coord.py``): with
+``elastic.coordinator_url`` set, the registry is wrapped in a
+:class:`~deepfm_tpu.elastic.coord.CoordinatedRegistry` — epochs and device
+sets come from the coordinator's CONSENSUS over every process's local
+view, the drain→reshard transition runs as a two-phase barrier (no
+process reshards alone), and every commit/publish carries the lease's
+monotone fencing token, which the checkpoint and publish roots enforce
+(a zombie's stale-token write raises ``StaleFencingTokenError`` instead
+of corrupting the lineage).  With ``elastic.publisher_split`` the trainer
+only commits; a separate ``--task_type publish`` process (MPMD,
+``elastic/mpmd.py``) tails the committed payloads and publishes
+asynchronously, so a publish-store outage degrades freshness instead of
+stalling the train step.  Degradation is graceful in both directions:
+coordinator unreachable → frozen-topology training under a breaker
+(flight-recorded); lease expired → commit-free draining until
+re-admission.
 """
 
 from __future__ import annotations
@@ -58,9 +75,11 @@ from ..parallel import (
     shard_batch,
 )
 from ..obs import flight as obs_flight
+from ..obs.metrics import MetricsRegistry
 from ..parallel.spmd import TABLE_KEYS
 from ..train.step import TrainState
 from ..utils import MetricLogger
+from .coord import Fence, StaleFencingTokenError
 from .plan import ReshardPlan, choose_mesh, plan_reshard
 from .registry import VirtualDeviceRegistry
 
@@ -101,12 +120,14 @@ class ElasticTrainer:
         registry=None,
         stream_root: str | None = None,
         publish_root: str | None = None,
+        metrics: MetricsRegistry | None = None,
     ):
-        if jax.process_count() > 1:
+        if jax.process_count() > 1 and not cfg.elastic.coordinator_url:
             raise ValueError(
-                "elastic training is single-process (one logical writer "
-                "over the event log); multi-host elasticity composes this "
-                "controller with per-process registries"
+                "multi-process elastic training needs "
+                "elastic.coordinator_url: without the coordinator's epoch "
+                "consensus + lease fencing there is no single enforced "
+                "logical writer over the event log (elastic/coord.py)"
             )
         if cfg.model.model_name == "two_tower":
             raise ValueError(
@@ -116,6 +137,21 @@ class ElasticTrainer:
         self.cfg = cfg
         self.registry = registry if registry is not None \
             else VirtualDeviceRegistry()
+        if cfg.elastic.coordinator_url \
+                and not hasattr(self.registry, "ack_drain"):
+            # wrap the local registry in the consensus client: epochs and
+            # device sets now come from the coordinator's merged view, and
+            # commits/publishes carry the lease's fencing token
+            import os as _os
+
+            from .coord import CoordClient, CoordinatedRegistry
+
+            pid = f"p{jax.process_index()}-{_os.getpid()}"
+            self.registry = CoordinatedRegistry(
+                self.registry,
+                CoordClient(cfg.elastic.coordinator_url, pid, role="train"),
+                heartbeat_interval_secs=cfg.elastic.heartbeat_interval_secs,
+            )
         self._stream_root = stream_root or cfg.data.training_data_dir
         self._publish_root = publish_root or cfg.run.servable_model_dir
         if not self._stream_root:
@@ -137,15 +173,75 @@ class ElasticTrainer:
         self.reshards: list[dict] = []
         self.lifecycle: list[dict] = []
         self.cursor_lineage: list[StreamCursor] = []
+        # elastic lifecycle on the obs registry (deepfm_elastic_*): the
+        # flight recorder gives the incident TIMELINE, these give the
+        # alertable AGGREGATES (a drain_commit_failed was previously
+        # invisible to Prometheus)
+        m = metrics or MetricsRegistry()
+        self.metrics = m
+        self._m_epoch = m.gauge(
+            "deepfm_elastic_epoch", "membership epoch the trainer is on")
+        self._m_reshard = m.histogram(
+            "deepfm_elastic_reshard_seconds",
+            "detect->drain->commit->replan->restore wall time", window=256)
+        self._m_drain_failed = m.counter(
+            "deepfm_elastic_drain_commit_failed_total",
+            "drain commits that failed (resume falls back to the last "
+            "periodic commit)")
+        self._m_reshards = m.counter(
+            "deepfm_elastic_reshards_total", "completed topology changes")
+        self._m_replayed = m.counter(
+            "deepfm_elastic_steps_replayed_total",
+            "optimizer steps replayed from the resume commit")
+        self._m_frozen = m.gauge(
+            "deepfm_elastic_frozen",
+            "1 while training on a frozen topology (coordinator "
+            "unreachable)")
+        self._m_fence_refused = m.counter(
+            "deepfm_elastic_fence_refused_total",
+            "writes refused by a stale fencing token")
+        self._m_lifecycle = m.counter(
+            "deepfm_elastic_lifecycle_total",
+            "lifecycle transitions by kind", labels=("kind",))
 
     # -- lifecycle bookkeeping ----------------------------------------------
     def _event(self, kind: str, **fields) -> None:
         self.lifecycle.append({"kind": kind, **fields})
         self._log.event(f"elastic_{kind}", **fields)
+        self._m_lifecycle.labels(kind).inc()
         # the same lifecycle feeds the crash flight recorder (obs/flight):
         # a chaos drill's drain/reshard/resume lands in one correlated
         # timeline with swaps, breaker trips and ejections
         obs_flight.record(f"elastic_{kind}", subsystem="elastic", **fields)
+
+    def metrics_snapshot(self) -> dict:
+        """The ``elastic`` metrics section, rendered FROM the registry
+        (the ``/v1/metrics`` discipline: JSON sections re-derive from the
+        same families Prometheus scrapes, so the two can never drift)."""
+        return {
+            "epoch": int(self._m_epoch.value),
+            "reshards": self._m_reshard.snapshot(include_max=True),
+            "reshards_total": int(self._m_reshards.value),
+            "drain_commit_failed": int(self._m_drain_failed.value),
+            "steps_replayed": int(self._m_replayed.value),
+            "frozen": bool(self._m_frozen.value),
+            "fence_refused": int(self._m_fence_refused.value),
+            "lifecycle": {
+                kind: int(child.value)
+                for (kind,), child in sorted(
+                    self._m_lifecycle.children().items())
+            },
+        }
+
+    def _fence_for(self, root: str) -> Fence | None:
+        """A Fence bound to the registry's CURRENT lease token, or None
+        when uncoordinated (single-process: the constructor refusal is the
+        writer guarantee, as before)."""
+        token = getattr(self.registry, "fence_token", None)
+        if not token:
+            return None
+        return Fence(root, token, holder=getattr(
+            getattr(self.registry, "_client", None), "pid", ""))
 
     def _current_epoch(self) -> int:
         """The registry's live membership epoch.  A polling registry
@@ -153,9 +249,10 @@ class ElasticTrainer:
         once-per-batch detection probe; push-style registries (the
         virtual one) just report their counter."""
         poll = getattr(self.registry, "poll", None)
-        if poll is not None:
-            return poll()
-        return self.registry.epoch
+        epoch = poll() if poll is not None else self.registry.epoch
+        self._m_frozen.set(
+            1.0 if getattr(self.registry, "frozen", False) else 0.0)
+        return epoch
 
     # -- topology -----------------------------------------------------------
     def _topology(self, epoch: int, devices) -> Topology:
@@ -170,6 +267,24 @@ class ElasticTrainer:
         ctx = make_context(self.cfg, mesh)
         step = make_spmd_train_step(ctx)
         return Topology(epoch=epoch, ctx=ctx, step=step, shape=(dp, mp))
+
+    def _admit(self, topo: Topology) -> None:
+        """A topology is built and restored: complete the coordinator's
+        reshard barrier (absent on plain registries) and take WRITE
+        ownership of the roots by advancing their fences to this lease's
+        token — from here on, any older token's commit or publish is
+        refused at the storage layer."""
+        self._m_epoch.set(topo.epoch)
+        ack = getattr(self.registry, "ack_topology", None)
+        if ack is not None:
+            ack(topo.epoch)
+        fence = self._fence_for(self.cfg.run.model_dir)
+        if fence is not None:
+            fence.advance()
+        if not self.cfg.elastic.publisher_split:
+            pub_fence = self._fence_for(self._publish_root)
+            if pub_fence is not None:
+                pub_fence.advance()
 
     def _wait_for_capacity(
         self, stop: threading.Event | None
@@ -200,7 +315,14 @@ class ElasticTrainer:
 
     # -- durability ---------------------------------------------------------
     def _commit(self, ckpt, state: TrainState, cursor: StreamCursor) -> None:
-        commit_payload(ckpt, state, cursor)
+        try:
+            commit_payload(ckpt, state, cursor,
+                           fence=self._fence_for(self.cfg.run.model_dir))
+        except StaleFencingTokenError:
+            self._m_fence_refused.inc()
+            self._event("fence_refused", root="model_dir",
+                        step=int(state.step))
+            raise
 
     def _publish(self, topo: Topology, state: TrainState,
                  cursor: StreamCursor):
@@ -210,6 +332,12 @@ class ElasticTrainer:
         matter which mesh trained it — the serving members' staged
         payloads keep hitting the same compiled executables across a
         shrink/grow, which is what keeps the pool swap 409-free."""
+        if self.cfg.elastic.publisher_split:
+            # MPMD: the `--task_type publish` process owns the publish
+            # root (its own lease + fencing token); the trainer's commits
+            # are the hand-off, and the hot loop never touches the
+            # publish store
+            return None
         true_vocab = topo.ctx.true_feature_size
         params = {}
         for k, v in state.params.items():
@@ -225,13 +353,20 @@ class ElasticTrainer:
             opt_state=None,
             rng=state.rng,
         )
-        manifest = self.publisher.publish(
-            self.cfg, pub_state,
-            cursor={"segment": cursor.segment, "record": cursor.record},
-            watermark=self.reader.watermark(),
-            extra={"elastic": {"mesh": list(topo.shape),
-                               "epoch": topo.epoch}},
-        )
+        try:
+            manifest = self.publisher.publish(
+                self.cfg, pub_state,
+                cursor={"segment": cursor.segment, "record": cursor.record},
+                watermark=self.reader.watermark(),
+                extra={"elastic": {"mesh": list(topo.shape),
+                                   "epoch": topo.epoch}},
+                fence=self._fence_for(self._publish_root),
+            )
+        except StaleFencingTokenError:
+            self._m_fence_refused.inc()
+            self._event("fence_refused", root="publish",
+                        step=int(state.step))
+            raise
         self._event("publish", version=manifest.version,
                     step=manifest.step, mesh=list(topo.shape))
         return manifest
@@ -254,15 +389,28 @@ class ElasticTrainer:
                     from_mesh=list(topo.shape))
         # drain: block on the state the last dispatched step produced —
         # synchronous SPMD means no other work can be in flight
-        if self.cfg.elastic.drain_commit:
+        fenced = bool(getattr(self.registry, "fenced", False))
+        if self.cfg.elastic.drain_commit and not fenced:
             try:
                 jax.block_until_ready(state)
                 self._commit(ckpt, state, cursor)
                 self._event("drain_commit", step=step_before,
                             segment=cursor.segment, record=cursor.record)
             except Exception as e:
+                self._m_drain_failed.inc()
                 self._event("drain_commit_failed",
                             error=f"{type(e).__name__}: {e}"[:200])
+        elif fenced:
+            # lease expired: this process's token is stale by construction,
+            # so it drains COMMIT-FREE — the last fenced commit is the
+            # resume point and the tail replays after re-admission
+            self._event("self_fenced", step=step_before)
+        # two-phase barrier (coordinated registries): report "drained" and
+        # wait — the consensus device set only becomes visible once every
+        # old-epoch process drained, so no process reshards alone
+        ack_drain = getattr(self.registry, "ack_drain", None)
+        if ack_drain is not None and not fenced:
+            ack_drain()
         epoch, devices = self._wait_for_capacity(stop)
         new_topo = self._topology(epoch, devices)
         plan = plan_reshard(topo.ctx, new_topo.ctx)
@@ -274,6 +422,7 @@ class ElasticTrainer:
         )
         state = payload.train
         cursor = payload.cursor()
+        self._admit(new_topo)
         # truncate the lineage to the committed resume point: batches
         # past the cursor were applied only to the DISCARDED state and
         # will replay — along the surviving lineage each event counts once
@@ -287,6 +436,9 @@ class ElasticTrainer:
             "resume_step": int(state.step),
         }
         self.reshards.append(record)
+        self._m_reshard.observe(wall)
+        self._m_reshards.inc()
+        self._m_replayed.inc(max(0, record["steps_replayed"]))
         self._event("reshard", **{k: record[k] for k in
                                   ("from_mesh", "to_mesh", "wall_secs",
                                    "steps_replayed", "moved_bytes")})
@@ -333,6 +485,7 @@ class ElasticTrainer:
         )
         epoch, devices = self._wait_for_capacity(stop)
         topo = self._topology(epoch, devices)
+        self._admit(topo)
         cursor = StreamCursor()
         if ckpt.latest_step() is None:
             state = create_spmd_state(topo.ctx)
@@ -448,7 +601,8 @@ def run_elastic_train(cfg: Config) -> TrainState:
     ``online_idle_timeout_secs``."""
     from .registry import LiveDeviceRegistry
 
-    trainer = ElasticTrainer(cfg, registry=LiveDeviceRegistry())
+    trainer = ElasticTrainer(cfg, registry=LiveDeviceRegistry(
+        debounce_polls=cfg.elastic.registry_debounce_polls))
     stop = threading.Event()
     restore: list[tuple] = []
     if threading.current_thread() is threading.main_thread():
@@ -467,6 +621,9 @@ def run_elastic_train(cfg: Config) -> TrainState:
             idle_timeout_secs=cfg.run.online_idle_timeout_secs,
         )
     finally:
+        release = getattr(trainer.registry, "release", None)
+        if release is not None:
+            release()  # clean lease hand-back; the TTL covers crashes
         if restore:
             import signal
 

@@ -111,16 +111,31 @@ class LiveDeviceRegistry:
     runtime raise until reinitialized) bumps the epoch.  ``devices()``
     returns the last successful read, so the controller can still drain
     and commit on surviving state while the runtime churns.
+
+    **Debounce**: a single anomalous poll does NOT bump the epoch — the
+    same changed reading must repeat ``debounce_polls`` consecutive times
+    (default 2, ``elastic.registry_debounce_polls``).  A transient
+    device-query hiccup (runtime briefly raising, a one-poll id blip)
+    would otherwise cost a full drain/commit/reshard/publish cycle for a
+    topology that never actually changed; a real slice loss is still
+    detected one poll later, which is noise next to the reshard itself.
+    A reading that reverts before confirming resets the count.
     """
 
-    def __init__(self):
+    def __init__(self, *, debounce_polls: int = 2):
         import jax
 
+        if debounce_polls < 1:
+            raise ValueError(
+                f"debounce_polls must be >= 1, got {debounce_polls}")
         self._jax = jax
+        self._debounce = int(debounce_polls)
         self._lock = threading.Lock()
         self._epoch = 0
         self._last = tuple(jax.devices())
         self._last_ids = tuple(d.id for d in self._last)
+        self._pending_ids: tuple | None = None
+        self._pending_count = 0
 
     @property
     def epoch(self) -> int:
@@ -132,7 +147,8 @@ class LiveDeviceRegistry:
             return self._last
 
     def poll(self) -> int:
-        """Re-read backend liveness; bump the epoch on any change."""
+        """Re-read backend liveness; bump the epoch once the SAME changed
+        reading has held for ``debounce_polls`` consecutive polls."""
         try:
             live = tuple(self._jax.devices())
             ids = tuple(d.id for d in live)
@@ -140,11 +156,23 @@ class LiveDeviceRegistry:
         except Exception:
             live, ids = (), ()
         with self._lock:
-            if ids != self._last_ids:
+            if ids == self._last_ids:
+                # back to the committed reading: the anomaly was transient
+                self._pending_ids = None
+                self._pending_count = 0
+                return self._epoch
+            if ids == self._pending_ids:
+                self._pending_count += 1
+            else:
+                self._pending_ids = ids
+                self._pending_count = 1
+            if self._pending_count >= self._debounce:
                 self._epoch += 1
                 if live:  # keep the last good list while the runtime churns
                     self._last = live
                 self._last_ids = ids
+                self._pending_ids = None
+                self._pending_count = 0
             return self._epoch
 
     def snapshot(self) -> tuple[int, tuple]:

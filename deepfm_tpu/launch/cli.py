@@ -59,11 +59,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--task_type",
         choices=["train", "eval", "infer", "export", "serve",
-                 "online-train", "online_train"],
+                 "online-train", "online_train", "publish"],
         help="task dispatch (reference ps:77-79; serve = online scoring "
              "over the exported servable; online-train = continuous "
              "training from an event log with versioned publishes the "
-             "serving engine hot-reloads)",
+             "serving engine hot-reloads; publish = the MPMD publisher "
+             "half of the elastic trainer/publisher split — tails "
+             "committed payloads in model_dir and publishes versioned "
+             "servables asynchronously, elastic/mpmd.py)",
     )
     # the high-traffic flags get first-class spellings (parity with the
     # reference's most-used hyperparameters, ps nb cell 4)
@@ -105,6 +108,19 @@ def build_parser() -> argparse.ArgumentParser:
         "--funnel_return_n", type=int,
         help="funnel serving: ranked items returned per user "
              "(0 = the servable's funnel.json default)",
+    )
+    p.add_argument(
+        "--coordinator_url",
+        help="multi-host elastic coordination service "
+             "(deepfm_tpu/elastic/coord.py; run one with `python -m "
+             "deepfm_tpu.elastic.coord`): training processes hold TTL "
+             "leases, agree on membership epochs, and fence every "
+             "commit/publish with the lease's monotone token",
+    )
+    p.add_argument(
+        "--lease_ttl_secs", type=float,
+        help="coordination lease TTL — a process silent this long is "
+             "expired from consensus and its fencing token goes stale",
     )
     p.add_argument(
         "--serve_tenants",
@@ -152,6 +168,8 @@ _FLAG_MAP = {
     "funnel_top_k": ("run", "funnel_top_k"),
     "funnel_return_n": ("run", "funnel_return_n"),
     "serve_tenants": ("fleet", "tenants"),
+    "coordinator_url": ("elastic", "coordinator_url"),
+    "lease_ttl_secs": ("elastic", "lease_ttl_secs"),
 }
 
 

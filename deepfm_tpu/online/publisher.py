@@ -215,15 +215,27 @@ class ModelPublisher:
         cursor: dict | None = None,
         watermark: float = 0.0,
         extra: dict | None = None,
+        fence=None,
     ) -> Manifest:
         """Write the servable tree for ``state``, then the manifest LAST.
 
         Crash at any point before the manifest write leaves an invisible
         partial version; the next publish claims a fresh version number
         (numbers are taken from committed manifests only, so an orphaned
-        tree is overwritten or ignored, never resurrected)."""
+        tree is overwritten or ignored, never resurrected).
+
+        ``fence`` (:class:`~deepfm_tpu.elastic.coord.Fence`) enforces the
+        single-publisher contract under the MPMD split: the publish is
+        REFUSED up front (``StaleFencingTokenError``) when a newer lease
+        holder already advanced this root's recorded token, the manifest
+        records the writer's token (``extra["fence_token"]``), and a
+        successful publish advances the mark."""
         from ..serve.export import export_servable
 
+        extra = dict(extra or {})
+        if fence is not None:
+            fence.check()
+            extra["fence_token"] = int(fence.token)
         version = self.next_version()
         manifest = Manifest(
             version=version,
@@ -235,11 +247,14 @@ class ModelPublisher:
             created_unix=time.time(),
             cursor=cursor,
             watermark=float(watermark),
-            extra=extra or {},
+            extra=extra,
         )
-        return self._publish_artifact(
+        out = self._publish_artifact(
             manifest, lambda dest: export_servable(cfg, state, dest)
         )
+        if fence is not None:
+            fence.advance()
+        return out
 
     def publish_tiered(
         self,
@@ -344,6 +359,43 @@ class ModelPublisher:
             os.replace(tmp_path, path)  # the atomic publish point
         self._retain()
         return manifest
+
+    def clean_orphans(self) -> list[int]:
+        """Delete ``versions/<v>/`` trees that have NO committed manifest —
+        the residue of a publisher killed between artifact write and
+        manifest write (invisible to readers, but paying storage and
+        confusing audits forever).  Returns the version numbers removed.
+
+        Run at publisher STARTUP only: the root is single-writer by lease
+        (elastic/coord.py), so no other incarnation can be mid-publish
+        here — an uncommitted tree at boot is guaranteed residue, never a
+        publish in flight.  Readers are unaffected throughout: versions
+        resolve manifest-first (``resolve_version``), and an orphan has
+        none."""
+        committed = set(list_versions(self.root))
+        removed: list[int] = []
+        if is_url(self.root):
+            base = join_url(self.root, _VERSIONS) + "/"
+            names = {u[len(base):].split("/", 1)[0]
+                     for u in get_store().list_prefix(base)}
+        else:
+            vdir = os.path.join(self.root, _VERSIONS)
+            names = set(os.listdir(vdir)) if os.path.isdir(vdir) else set()
+        for name in sorted(names):
+            try:
+                v = int(name)
+            except ValueError:
+                continue
+            if v in committed:
+                continue
+            if is_url(self.root):
+                get_store().delete_prefix(
+                    join_url(self.root, _VERSIONS, name) + "/")
+            else:
+                shutil.rmtree(os.path.join(self.root, _VERSIONS, name),
+                              ignore_errors=True)
+            removed.append(v)
+        return removed
 
     def _retain(self) -> None:
         versions = list_versions(self.root)

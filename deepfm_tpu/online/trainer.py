@@ -70,16 +70,21 @@ class OnlinePayload(NamedTuple):
     """The atomic unit of online-training durability: weights + optimizer
     state (``train``) and the stream position they already contain, saved
     and restored together.  ``step`` mirrors ``train.step`` so the existing
-    Checkpointer step-keying works unchanged."""
+    Checkpointer step-keying works unchanged.  ``fence_token`` records the
+    writer's fencing token (elastic/coord.py; 0 = unfenced single-writer),
+    so the MPMD publisher and post-incident audits can attribute every
+    committed payload to the lease that wrote it."""
 
     step: jax.Array | np.ndarray
     train: TrainState
     cursor_segment: np.ndarray   # uint8 [256], zero-padded
     cursor_len: np.ndarray       # int32 scalar
     cursor_record: np.ndarray    # int64 scalar
+    fence_token: np.ndarray      # int64 scalar; 0 = unfenced
 
     @classmethod
-    def wrap(cls, train: TrainState, cursor: StreamCursor) -> "OnlinePayload":
+    def wrap(cls, train: TrainState, cursor: StreamCursor,
+             *, fence_token: int = 0) -> "OnlinePayload":
         seg, length, record = cursor_to_arrays(cursor)
         return cls(
             step=train.step,
@@ -87,6 +92,7 @@ class OnlinePayload(NamedTuple):
             cursor_segment=seg,
             cursor_len=length,
             cursor_record=record,
+            fence_token=np.asarray(int(fence_token), np.int64),
         )
 
     def cursor(self) -> StreamCursor:
@@ -95,7 +101,31 @@ class OnlinePayload(NamedTuple):
         )
 
 
-def commit_payload(ckpt, state: TrainState, cursor: StreamCursor) -> None:
+class _LegacyOnlinePayload(NamedTuple):
+    """The pre-fencing payload tree (no ``fence_token`` leaf) — kept ONLY
+    as a restore fallback so commits written before the multi-host PR
+    still resume (they upgrade to fence_token=0, the unfenced marker)."""
+
+    step: jax.Array | np.ndarray
+    train: TrainState
+    cursor_segment: np.ndarray
+    cursor_len: np.ndarray
+    cursor_record: np.ndarray
+
+
+def _upgrade_legacy(legacy: "_LegacyOnlinePayload") -> "OnlinePayload":
+    return OnlinePayload(
+        step=legacy.step,
+        train=legacy.train,
+        cursor_segment=legacy.cursor_segment,
+        cursor_len=legacy.cursor_len,
+        cursor_record=legacy.cursor_record,
+        fence_token=np.asarray(0, np.int64),
+    )
+
+
+def commit_payload(ckpt, state: TrainState, cursor: StreamCursor,
+                   *, fence=None) -> None:
     """Atomically persist {weights, optimizer state, cursor} — the
     exactly-once boundary, shared by the fixed-mesh and elastic trainers.
 
@@ -108,15 +138,30 @@ def commit_payload(ckpt, state: TrainState, cursor: StreamCursor) -> None:
     checkpoints.  The post-save membership check turns the remaining
     failure mode — a save that silently never landed (full disk swallowed
     by an async layer) — into a loud error at the commit site instead of
-    a missing resume point at the next restart."""
+    a missing resume point at the next restart.
+
+    ``fence`` (an :class:`~deepfm_tpu.elastic.coord.Fence`) makes the
+    single-logical-writer contract ENFORCED under multi-host elasticity:
+    the commit is refused up front (``StaleFencingTokenError``) when a
+    newer lease holder already advanced the checkpoint root's recorded
+    token, the payload records the writer's token, and a successful commit
+    advances the mark — a zombie that missed a membership epoch cannot
+    corrupt the lineage."""
     step = int(state.step)
-    ckpt.save(OnlinePayload.wrap(state, cursor), block=True)
+    token = 0
+    if fence is not None:
+        fence.check()
+        token = fence.token
+    ckpt.save(OnlinePayload.wrap(state, cursor, fence_token=token),
+              block=True)
     if step not in ckpt.all_steps():
         raise RuntimeError(
             f"commit at step {step} did not become durable (committed "
             f"steps: {ckpt.all_steps()}) — refusing to consume past an "
             f"unpersisted cursor"
         )
+    if fence is not None:
+        fence.advance()
 
 
 def restore_latest_payload(ckpt, template: "OnlinePayload") -> "OnlinePayload":
@@ -132,15 +177,23 @@ def restore_latest_payload(ckpt, template: "OnlinePayload") -> "OnlinePayload":
     steps = sorted(ckpt.all_steps(), reverse=True)
     if not steps:
         raise FileNotFoundError("no checkpoint to restore")
+    legacy_template = _LegacyOnlinePayload(*template[:5])
     last_err: Exception | None = None
     for s in steps:
         try:
             return ckpt.restore(template, step=s)
         except Exception as e:
             last_err = e
+        try:
+            # pre-fencing commit (no fence_token leaf): restore with the
+            # legacy tree and upgrade, instead of misreading a format
+            # difference as a torn step
+            return _upgrade_legacy(ckpt.restore(legacy_template, step=s))
+        except Exception:
             logging.getLogger(__name__).warning(
                 "checkpoint step %d unreadable (%s: %s) — falling back to "
-                "the previous complete payload", s, type(e).__name__, e)
+                "the previous complete payload", s,
+                type(last_err).__name__, last_err)
     raise RuntimeError(
         f"every checkpoint step {steps} is unreadable; last error: "
         f"{type(last_err).__name__}: {last_err}"

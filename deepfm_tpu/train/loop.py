@@ -765,6 +765,14 @@ def run_task(cfg: Config):
         from ..online.trainer import run_online_train
 
         return run_online_train(cfg)
+    if task == "publish":
+        # the MPMD publisher half of the elastic trainer/publisher split
+        # (elastic/mpmd.py): tail committed payloads in model_dir and
+        # publish versioned servables asynchronously — a publish-store
+        # outage degrades freshness, never the trainer's hot loop
+        from ..elastic.mpmd import run_publisher
+
+        return run_publisher(cfg)
     if task == "serve":
         from ..serve.server import serve_forever, serve_pool
 
@@ -854,5 +862,6 @@ def run_task(cfg: Config):
     if task == "export":
         return run_export(cfg)
     raise ValueError(
-        f"unknown task_type {task!r} (train|eval|infer|export|serve)"
+        f"unknown task_type {task!r} "
+        f"(train|eval|infer|export|serve|online-train|publish)"
     )

@@ -14,18 +14,26 @@ if [[ "${1:-}" == "--json" ]]; then
     fmt="json"
 fi
 
-# Slow gate (CHECK_SLOW=1 or --slow): the elastic chaos drill — kill and
-# restore virtual-mesh devices mid-run ([2,4]→[1,4]→[2,4]) and hold the run
-# to the ISSUE-9 acceptance bar: loss-curve continuity vs an uninterrupted
-# baseline, exactly-once cursor lineage, 0 failed / 0 mixed-version predicts
-# at the serving pool (tests/test_elastic_chaos.py; same code path emits
-# docs/BENCH_ELASTIC.json via `python bench.py --elastic`).  Off by default:
-# the drill trains two full runs and serves under load (~minutes), which
-# does not belong in the per-commit static gate.
+# Slow gate (CHECK_SLOW=1 or --slow): the elastic chaos drills — (1) kill
+# and restore virtual-mesh devices mid-run ([2,4]→[1,4]→[2,4]) and hold the
+# run to the ISSUE-9 acceptance bar: loss-curve continuity vs an
+# uninterrupted baseline, exactly-once cursor lineage, 0 failed /
+# 0 mixed-version predicts at the serving pool (tests/test_elastic_chaos.py;
+# same code path emits docs/BENCH_ELASTIC.json via `python bench.py
+# --elastic`); (2) the MULTI-HOST drill (tests/test_elastic_multihost.py):
+# the same mesh cycle under lease-fenced epoch consensus with the MPMD
+# trainer/publisher split across real processes, a FaultPlan-scripted
+# coordinator outage (frozen-topology training), and a stale-token writer
+# refused on both the commit and publish path (emits
+# docs/BENCH_ELASTIC_MULTIHOST.json via `python bench.py
+# --elastic-multihost`).  Off by default: each drill trains two full runs
+# and serves under load (~minutes), which does not belong in the
+# per-commit static gate.
 if [[ "${CHECK_SLOW:-0}" == "1" || "${1:-}" == "--slow" || "${2:-}" == "--slow" ]]; then
     env JAX_PLATFORMS=cpu \
         XLA_FLAGS="${XLA_FLAGS:+$XLA_FLAGS }--xla_force_host_platform_device_count=8" \
-        python -m pytest tests/test_elastic_chaos.py -q -m slow \
+        python -m pytest tests/test_elastic_chaos.py \
+        tests/test_elastic_multihost.py -q -m slow \
         -p no:cacheprovider
 fi
 

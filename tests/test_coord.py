@@ -1,0 +1,681 @@
+"""Multi-host elastic coordination (deepfm_tpu/elastic/coord.py +
+elastic/mpmd.py): lease/consensus/barrier semantics on a fake clock,
+the HTTP client with FaultPlan-scripted outages, fencing tokens ENFORCED
+through commit_payload and ModelPublisher.publish, the CoordinatedRegistry
+degradation modes (frozen topology, self-fence), and the MPMD publisher's
+payload tailing + cross-incarnation orphan cleanup."""
+
+import json
+import os
+import threading
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from deepfm_tpu.core.config import Config
+from deepfm_tpu.elastic.coord import (
+    CoordClient,
+    CoordinatedRegistry,
+    Coordinator,
+    CoordUnreachableError,
+    Fence,
+    LeaseExpired,
+    StaleFencingTokenError,
+    merge_views,
+    read_fence,
+    serve_coordinator,
+    write_fence,
+)
+from deepfm_tpu.elastic.registry import VirtualDeviceRegistry
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _dev(i):
+    return SimpleNamespace(id=i)
+
+
+def _devs(*ids):
+    return [_dev(i) for i in ids]
+
+
+def _tiny_cfg(root, **overrides):
+    base = {
+        "model": {
+            "feature_size": 16,
+            "field_size": 3,
+            "embedding_size": 2,
+            "deep_layers": (4,),
+            "dropout_keep": (1.0,),
+            "compute_dtype": "float32",
+        },
+        "run": {
+            "model_dir": os.path.join(root, "ckpt"),
+            "servable_model_dir": os.path.join(root, "publish"),
+            "log_steps": 10_000,
+        },
+    }
+    for section, fields in overrides.items():
+        base[section] = {**base.get(section, {}), **fields}
+    return Config.from_dict(base)
+
+
+# --------------------------------------------------------------- merge
+
+
+def test_merge_views_is_intersection_and_order_independent():
+    views = {"p0": (0, 1, 2, 3), "p1": (1, 2, 3, 9)}
+    assert merge_views(views) == (1, 2, 3)
+    assert merge_views({"p1": (1, 2, 3, 9), "p0": (0, 1, 2, 3)}) \
+        == (1, 2, 3)
+    # order follows the smallest pid's view, not sorted ids
+    assert merge_views({"p0": (3, 1, 2), "p1": (1, 2, 3)}) == (3, 1, 2)
+    assert merge_views({}) == ()
+    assert merge_views({"p0": ()}) == ()
+
+
+# --------------------------------------------- coordinator state machine
+
+
+def test_single_member_join_reshard_steady():
+    clock = FakeClock()
+    co = Coordinator(lease_ttl_secs=10, clock=clock)
+    r = co.acquire("p0", view=[0, 1, 2, 3])
+    c = r["consensus"]
+    # first join: nothing to drain, straight to the reshard phase of a
+    # fresh epoch with the member's view as consensus
+    assert c["phase"] == "reshard" and c["epoch"] == 1
+    assert c["devices"] == [0, 1, 2, 3]
+    r2 = co.ack("p0", r["lease"]["lease_id"], "reshard", c["transition"])
+    assert r2["consensus"]["phase"] == "steady"
+    # heartbeat refreshes the lease without perturbing consensus
+    r3 = co.heartbeat("p0", r["lease"]["lease_id"], view=[0, 1, 2, 3])
+    assert r3["consensus"]["phase"] == "steady"
+    assert r3["consensus"]["epoch"] == 1
+
+
+def test_two_trainers_drain_barrier_holds_until_all_ack():
+    clock = FakeClock()
+    co = Coordinator(lease_ttl_secs=10, clock=clock)
+    ra = co.acquire("pA", view=[0, 1, 2, 3, 4, 5, 6, 7])
+    la = ra["lease"]["lease_id"]
+    t1 = ra["consensus"]["transition"]
+    co.ack("pA", la, "reshard", t1)
+    rb = co.acquire("pB", view=[0, 1, 2, 3, 4, 5, 6, 7])
+    lb = rb["lease"]["lease_id"]
+    # same view: joining does not open a transition
+    assert rb["consensus"]["phase"] == "steady"
+    # B registers the epoch it trains on through its heartbeat
+    co.heartbeat("pB", lb, on_epoch=1)
+
+    # A loses a slice: transition opens, and the new device set must NOT
+    # become visible before BOTH admitted trainers drained
+    r = co.heartbeat("pA", la, view=[0, 1, 2, 3])
+    c = r["consensus"]
+    assert c["phase"] == "drain" and c["pending_epoch"] == 2
+    tok_a_before = r["lease"]["token"]
+    r = co.ack("pA", la, "drain", c["transition"])
+    assert r["consensus"]["phase"] == "drain"  # B has not drained
+    r = co.ack("pB", lb, "drain", c["transition"])
+    c2 = r["consensus"]
+    assert c2["phase"] == "reshard" and c2["epoch"] == 2
+    assert c2["devices"] == [0, 1, 2, 3]  # the intersection
+    # fencing tokens re-issued to the survivors at the epoch flip
+    assert r["lease"]["token"] > tok_a_before
+    co.ack("pA", la, "reshard", c2["transition"])
+    r = co.ack("pB", lb, "reshard", c2["transition"])
+    assert r["consensus"]["phase"] == "steady"
+
+
+def test_lease_expiry_drops_member_and_stales_its_token():
+    clock = FakeClock()
+    co = Coordinator(lease_ttl_secs=10, clock=clock)
+    ra = co.acquire("pA", view=[0, 1])
+    la = ra["lease"]["lease_id"]
+    co.ack("pA", la, "reshard", ra["consensus"]["transition"])
+    rb = co.acquire("pB", view=[0, 1])
+    lb = rb["lease"]["lease_id"]
+    tok_b = rb["lease"]["token"]
+    co.heartbeat("pB", lb, on_epoch=1)
+
+    # B goes silent past the TTL while A keeps heartbeating
+    clock.advance(6)
+    co.heartbeat("pA", la, on_epoch=1)
+    clock.advance(6)
+    r = co.heartbeat("pA", la)
+    # B expired: merged view unchanged ([0,1] both) -> no device change,
+    # no transition; but B's lease is gone
+    with pytest.raises(LeaseExpired):
+        co.heartbeat("pB", lb)
+    # re-admission issues a strictly newer token: the old one is stale
+    rb2 = co.acquire("pB", view=[0, 1])
+    assert rb2["lease"]["token"] > tok_b
+    assert r["consensus"]["epoch"] == rb2["consensus"]["epoch"]
+
+
+def test_expiry_of_a_diverging_member_recomputes_consensus():
+    clock = FakeClock()
+    co = Coordinator(lease_ttl_secs=10, clock=clock)
+    ra = co.acquire("pA", view=[0, 1, 2, 3])
+    la = ra["lease"]["lease_id"]
+    co.ack("pA", la, "reshard", ra["consensus"]["transition"])
+    rb = co.acquire("pB", view=[0, 1])  # B can only address half
+    c = rb["consensus"]
+    assert c["phase"] == "drain" and c["pending_devices"] == [0, 1]
+    # B dies before the barrier completes: the transition must re-target
+    # A's full view instead of deadlocking on a dead member's ack
+    clock.advance(6)
+    co.heartbeat("pA", la)
+    clock.advance(6)  # B is now 12s silent (> ttl), A only 6s
+    r = co.heartbeat("pA", la, view=[0, 1, 2, 3])
+    c2 = r["consensus"]
+    assert c2["pending_devices"] == [0, 1, 2, 3]
+    r = co.ack("pA", la, "drain", c2["transition"])
+    assert r["consensus"]["devices"] == [0, 1, 2, 3]
+
+
+def test_barrier_restart_invalidates_stale_acks():
+    clock = FakeClock()
+    co = Coordinator(lease_ttl_secs=10, clock=clock)
+    ra = co.acquire("pA", view=[0, 1, 2, 3])
+    la = ra["lease"]["lease_id"]
+    co.ack("pA", la, "reshard", ra["consensus"]["transition"])
+    rb = co.acquire("pB", view=[0, 1, 2, 3])
+    lb = rb["lease"]["lease_id"]
+    co.heartbeat("pB", lb, on_epoch=1)
+
+    r = co.heartbeat("pA", la, view=[0, 1, 2])
+    t_first = r["consensus"]["transition"]
+    co.ack("pA", la, "drain", t_first)
+    # the view moves AGAIN mid-barrier: transition restarts, A's old ack
+    # must not count toward the new one
+    r = co.heartbeat("pA", la, view=[0, 1])
+    c = r["consensus"]
+    assert c["transition"] > t_first and c["phase"] == "drain"
+    r = co.ack("pB", lb, "drain", c["transition"])
+    assert r["consensus"]["phase"] == "drain"  # A re-ack still missing
+    r = co.ack("pA", la, "drain", c["transition"])
+    assert r["consensus"]["phase"] == "reshard"
+    assert r["consensus"]["devices"] == [0, 1]
+
+
+# ------------------------------------------------------- HTTP + client
+
+
+def test_http_roundtrip_lease_expiry_and_fault_plan():
+    clock = FakeClock()
+    server, url, co = serve_coordinator(
+        Coordinator(lease_ttl_secs=10, clock=clock))
+    try:
+        cl = CoordClient(url, "p0")
+        r = cl.acquire(view=[0, 1])
+        assert cl.token == r["lease"]["token"]
+        cl.ack("reshard", r["consensus"]["transition"])
+        r2 = cl.heartbeat(view=[0, 1], on_epoch=1)
+        assert r2["consensus"]["phase"] == "steady"
+
+        # scripted outage: every endpoint 503s -> CoordUnreachableError
+        server.fault_plan.set_rules(
+            [{"verb": "*", "key": "*", "status": 503}])
+        with pytest.raises(CoordUnreachableError):
+            cl.heartbeat(view=[0, 1])
+        server.fault_plan.clear()
+
+        # the breaker may have opened on the failures; surface is the
+        # same error until cooldown, then the probe heals it
+        cl.breaker._opened_at = -1e9  # force cooldown elapsed
+        assert cl.heartbeat(view=[0, 1])["consensus"]["epoch"] == 1
+
+        # server-side expiry surfaces as LeaseExpired (HTTP 410), and it
+        # does NOT count as coordinator unreachability
+        clock.advance(11)
+        with pytest.raises(LeaseExpired):
+            cl.heartbeat(view=[0, 1])
+        assert cl.breaker.state == "closed"
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_coordinated_registries_agree_and_reshard_together():
+    """The tentpole invariant end-to-end over HTTP: two processes' views
+    merge into ONE consensus epoch + device set, and neither can see the
+    post-shrink device set until BOTH drained."""
+    server, url, co = serve_coordinator(Coordinator(lease_ttl_secs=30))
+    try:
+        loc_a = VirtualDeviceRegistry(_devs(0, 1, 2, 3, 4, 5, 6, 7))
+        loc_b = VirtualDeviceRegistry(_devs(0, 1, 2, 3, 4, 5, 6, 7))
+        reg_a = CoordinatedRegistry(loc_a, CoordClient(url, "pA"),
+                                    heartbeat_interval_secs=0.0)
+        reg_b = CoordinatedRegistry(loc_b, CoordClient(url, "pB"),
+                                    heartbeat_interval_secs=0.0)
+        e_a, d_a = reg_a.snapshot()
+        reg_a.ack_topology(e_a)
+        e_b, d_b = reg_b.snapshot()
+        assert (e_a, [d.id for d in d_a]) == (e_b, [d.id for d in d_b])
+        reg_b.ack_topology(e_b)
+        reg_b.poll()  # registers on_epoch server-side
+
+        # process A loses a slice: BOTH registries must report the same
+        # pending epoch with an EMPTY device set until both drain
+        loc_a.fail(4, 5, 6, 7)
+        pend = reg_a.poll()
+        assert pend == e_a + 1
+        assert reg_a.snapshot() == (pend, ())
+        assert reg_b.poll() == pend
+        assert reg_b.snapshot() == (pend, ())
+        reg_a.ack_drain()
+        assert reg_a.snapshot() == (pend, ())  # B has not drained
+        reg_b.ack_drain()
+        e2a, d2a = reg_a.snapshot()
+        e2b, d2b = reg_b.snapshot()
+        assert e2a == e2b == pend
+        assert [d.id for d in d2a] == [d.id for d in d2b] == [0, 1, 2, 3]
+        tok_a, tok_b = reg_a.fence_token, reg_b.fence_token
+        assert tok_a != tok_b  # one token per lease, all monotone
+        reg_a.ack_topology(e2a)
+        reg_b.ack_topology(e2b)
+        assert co.phase == "steady" and co.epoch == pend
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_registry_frozen_topology_and_thaw():
+    server, url, _co = serve_coordinator(Coordinator(lease_ttl_secs=30))
+    try:
+        loc = VirtualDeviceRegistry(_devs(0, 1, 2, 3))
+        reg = CoordinatedRegistry(loc, CoordClient(url, "p0"),
+                                  heartbeat_interval_secs=0.0)
+        e, d = reg.snapshot()
+        reg.ack_topology(e)
+        # coordinator goes dark: the registry keeps the cached consensus
+        # (frozen topology) instead of erroring or resharding
+        server.fault_plan.set_rules(
+            [{"verb": "*", "key": "*", "status": 503}])
+        assert reg.poll() == e
+        assert reg.frozen and reg.frozen_polls > 0
+        e2, d2 = reg.snapshot()
+        assert e2 == e and [x.id for x in d2] == [x.id for x in d]
+        # heal: the next allowed probe thaws
+        server.fault_plan.clear()
+        reg._client.breaker._opened_at = -1e9
+        assert reg.poll() == e
+        assert not reg.frozen
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_registry_self_fences_on_expiry_and_readmits():
+    clock = FakeClock()
+    server, url, co = serve_coordinator(
+        Coordinator(lease_ttl_secs=10, clock=clock))
+    try:
+        loc = VirtualDeviceRegistry(_devs(0, 1, 2, 3))
+        reg = CoordinatedRegistry(loc, CoordClient(url, "p0"),
+                                  heartbeat_interval_secs=0.0)
+        e, _ = reg.snapshot()
+        reg.ack_topology(e)
+        tok = reg.fence_token
+        clock.advance(11)  # the coordinator expires the lease
+        # next poll: 410 -> self-fence (sentinel epoch, empty devices)
+        assert reg.poll() == -1
+        assert reg.fenced
+        # while re-admission is unavailable the registry stays fenced:
+        # sentinel epoch, EMPTY device set (commit-free draining)
+        server.fault_plan.set_rules(
+            [{"verb": "ACQUIRE", "key": "*", "times": 2, "status": 503}])
+        assert reg.snapshot() == (-1, ())  # both retry attempts refused
+        assert reg.fenced
+        # the following poll re-acquires: fresh lease, STRICTLY newer
+        # token, back on the live consensus
+        e2 = reg.poll()
+        assert not reg.fenced and e2 >= e
+        assert reg.fence_token > tok
+        # re-admission abandoned the old topology: the member must NOT
+        # re-register as admitted to an epoch it will never drain from —
+        # a later drain barrier would deadlock waiting for its ack
+        reg.poll()  # a heartbeat after re-admission
+        member = co.status()["members"][reg._client.pid]
+        assert member["admitted_epoch"] is None
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# ------------------------------------------------------------- fencing
+
+
+def test_fence_local_roundtrip_and_stale_refusal(tmp_path):
+    root = str(tmp_path / "r")
+    assert read_fence(root) == 0
+    Fence(root, 3, holder="a").advance()
+    assert read_fence(root) == 3
+    Fence(root, 5, holder="b").advance()  # monotone up
+    assert read_fence(root) == 5
+    with pytest.raises(StaleFencingTokenError):
+        Fence(root, 4, holder="zombie").check()
+    Fence(root, 5, holder="b").check()  # equal token: still the holder
+    assert read_fence(root) == 5
+
+
+def test_fence_remote_roundtrip(tmp_path):
+    from deepfm_tpu.utils.dev_object_store import serve
+
+    (tmp_path / "store" / "bucket").mkdir(parents=True)
+    server, base = serve(str(tmp_path / "store"))
+    try:
+        root = f"{base}/bucket/publish"
+        assert read_fence(root) == 0
+        write_fence(root, 7, holder="pub")
+        assert read_fence(root) == 7
+        with pytest.raises(StaleFencingTokenError):
+            Fence(root, 6).advance()
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_commit_payload_fence_enforced(tmp_path):
+    """The acceptance-bar half for commits: a deliberately stale-token
+    writer's commit is REFUSED deterministically (and durably changes
+    nothing), while the live holder's commit lands and records its
+    token in the payload."""
+    from deepfm_tpu.checkpoint import make_checkpointer
+    from deepfm_tpu.elastic.mpmd import read_payload_tree
+    from deepfm_tpu.online.stream import StreamCursor
+    from deepfm_tpu.online.trainer import commit_payload
+    from deepfm_tpu.train.step import create_train_state
+
+    cfg = _tiny_cfg(str(tmp_path))
+    state = create_train_state(cfg)
+    root = cfg.run.model_dir
+    ckpt = make_checkpointer(root)
+    try:
+        write_fence(root, 6, holder="live")
+        with pytest.raises(StaleFencingTokenError):
+            commit_payload(ckpt, state, StreamCursor(),
+                           fence=Fence(root, 5, holder="zombie"))
+        assert ckpt.all_steps() == []  # the refusal preceded the write
+        commit_payload(ckpt, state, StreamCursor(),
+                       fence=Fence(root, 7, holder="live"))
+        assert ckpt.all_steps() == [0]
+        assert read_fence(root) == 7  # a successful commit advances
+    finally:
+        ckpt.close()
+    _, tree = read_payload_tree(root)
+    assert int(np.asarray(tree["fence_token"])) == 7
+
+
+def test_publish_fence_enforced_and_recorded(tmp_path):
+    """The acceptance-bar half for publishes: stale token -> refused with
+    ZERO new versions; live token -> manifest records the token and the
+    root's mark advances."""
+    from deepfm_tpu.online import list_versions
+    from deepfm_tpu.online.publisher import ModelPublisher, read_manifest
+    from deepfm_tpu.train.step import create_train_state
+
+    cfg = _tiny_cfg(str(tmp_path))
+    state = create_train_state(cfg)
+    root = cfg.run.servable_model_dir
+    pub = ModelPublisher(root)
+    m = pub.publish(cfg, state, fence=Fence(root, 3, holder="live"))
+    assert m.extra["fence_token"] == 3
+    assert read_fence(root) == 3
+    with pytest.raises(StaleFencingTokenError):
+        pub.publish(cfg, state, fence=Fence(root, 2, holder="zombie"))
+    assert list_versions(root) == [1]  # nothing was committed
+    assert read_manifest(root, 1).extra["fence_token"] == 3
+
+
+# ------------------------------------------------- MPMD publisher split
+
+
+def test_payload_publisher_tails_commits_bit_identically(tmp_path):
+    """The publisher process publishes EXACTLY what the trainer would
+    have: same step, same param_hash (its host-side restore + true-vocab
+    slice is the same transform), and only NEW commits trigger work."""
+    from deepfm_tpu.checkpoint import make_checkpointer
+    from deepfm_tpu.elastic.mpmd import PayloadPublisher
+    from deepfm_tpu.online import latest_manifest
+    from deepfm_tpu.online.publisher import param_tree_hash
+    from deepfm_tpu.online.stream import StreamCursor
+    from deepfm_tpu.online.trainer import commit_payload
+    from deepfm_tpu.train.step import create_train_state
+
+    cfg = _tiny_cfg(str(tmp_path), elastic={"publisher_split": True})
+    state = create_train_state(cfg)
+    ckpt = make_checkpointer(cfg.run.model_dir)
+    try:
+        commit_payload(ckpt, state, StreamCursor())
+        state2 = state._replace(step=state.step + 3)
+        commit_payload(
+            ckpt, state2,
+            StreamCursor(segment="000000000001.tfrecords", record=5))
+    finally:
+        ckpt.close()
+
+    pub = PayloadPublisher(cfg)
+    assert pub.publish_once() == 3  # newest commit, not both
+    m = latest_manifest(cfg.run.servable_model_dir)
+    assert m.step == 3
+    assert m.cursor == {"segment": "000000000001.tfrecords", "record": 5}
+    assert m.param_hash == param_tree_hash(state2.params,
+                                           state2.model_state)
+    assert pub.publish_once() is None  # nothing new
+    assert pub.metrics_snapshot()["published"] == 1
+
+
+def test_publisher_run_idle_exit_waits_for_first_commit(tmp_path):
+    """The idle clock must not start before the FIRST commit exists —
+    a slow-compiling trainer would otherwise outlive its publisher."""
+    from deepfm_tpu.checkpoint import make_checkpointer
+    from deepfm_tpu.elastic.mpmd import PayloadPublisher
+    from deepfm_tpu.online.stream import StreamCursor
+    from deepfm_tpu.online.trainer import commit_payload
+    from deepfm_tpu.train.step import create_train_state
+
+    cfg = _tiny_cfg(str(tmp_path),
+                    elastic={"publisher_split": True,
+                             "publish_poll_secs": 0.05})
+    pub = PayloadPublisher(cfg)
+    stop = threading.Event()
+    out: list[int] = []
+    t = threading.Thread(
+        target=lambda: out.append(
+            pub.run(stop=stop, idle_timeout_secs=0.4)),
+        daemon=True)
+    t.start()
+    # no commit yet: the publisher must still be alive well past the
+    # idle timeout
+    t.join(timeout=1.0)
+    assert t.is_alive()
+    state = create_train_state(cfg)
+    ckpt = make_checkpointer(cfg.run.model_dir)
+    try:
+        commit_payload(ckpt, state, StreamCursor())
+    finally:
+        ckpt.close()
+    t.join(timeout=30)  # publish, then idle out
+    assert not t.is_alive()
+    assert out == [1]
+
+
+def test_torn_publish_cleaned_by_next_incarnation_local(tmp_path):
+    """Kill between artifact write and manifest write: the orphan tree is
+    invisible to readers, and the NEXT publisher incarnation deletes it
+    at startup; serving only ever resolves complete manifests."""
+    from deepfm_tpu.online import list_versions
+    from deepfm_tpu.online.publisher import (
+        ModelPublisher,
+        resolve_version,
+        version_location,
+    )
+    from deepfm_tpu.train.step import create_train_state
+
+    cfg = _tiny_cfg(str(tmp_path))
+    root = cfg.run.servable_model_dir
+    pub = ModelPublisher(root)
+    pub.publish(cfg, create_train_state(cfg))
+
+    # incarnation 1 dies mid-publish of v2: tree written, no manifest
+    orphan = version_location(root, 2)
+    os.makedirs(orphan)
+    with open(os.path.join(orphan, "params.bin"), "wb") as f:
+        f.write(b"torn artifact bytes")
+    assert list_versions(root) == [1]  # invisible to readers
+    with pytest.raises(Exception):
+        resolve_version(root, 2, str(tmp_path / "staging"))
+
+    # incarnation 2 cleans at startup; committed versions untouched
+    removed = ModelPublisher(root).clean_orphans()
+    assert removed == [2]
+    assert not os.path.exists(orphan)
+    assert list_versions(root) == [1]
+    resolve_version(root, 1, str(tmp_path / "staging"))
+
+
+def test_torn_publish_cleaned_by_next_incarnation_remote(tmp_path):
+    from deepfm_tpu.data.object_store import get_store
+    from deepfm_tpu.online import list_versions
+    from deepfm_tpu.online.publisher import ModelPublisher
+    from deepfm_tpu.utils.dev_object_store import serve
+
+    (tmp_path / "store" / "bucket").mkdir(parents=True)
+    server, base = serve(str(tmp_path / "store"))
+    try:
+        root = f"{base}/bucket/publish"
+        # a previous incarnation uploaded part of v3, never the manifest
+        get_store().put(f"{root}/versions/00000003/params.bin", b"torn")
+        get_store().put(f"{root}/versions/00000003/sub/x.bin", b"torn2")
+        assert list_versions(root) == []
+        removed = ModelPublisher(root).clean_orphans()
+        assert removed == [3]
+        assert get_store().list_prefix(f"{root}/versions/") == []
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_legacy_payload_without_fence_token_still_restores(tmp_path):
+    """Commits written BEFORE the fencing PR lack the fence_token leaf;
+    restore must upgrade them (fence_token=0) instead of misreading the
+    format difference as a torn step and aborting the resume."""
+    from deepfm_tpu.checkpoint import make_checkpointer
+    from deepfm_tpu.online.stream import StreamCursor
+    from deepfm_tpu.online.trainer import (
+        OnlinePayload,
+        _LegacyOnlinePayload,
+        cursor_to_arrays,
+        restore_latest_payload,
+    )
+    from deepfm_tpu.train.step import create_train_state
+
+    cfg = _tiny_cfg(str(tmp_path))
+    state = create_train_state(cfg)
+    cursor = StreamCursor(segment="000000000002.tfrecords", record=7)
+    seg, length, record = cursor_to_arrays(cursor)
+    ckpt = make_checkpointer(cfg.run.model_dir)
+    try:
+        ckpt.save(_LegacyOnlinePayload(
+            step=state.step, train=state, cursor_segment=seg,
+            cursor_len=length, cursor_record=record), block=True)
+        restored = restore_latest_payload(
+            ckpt, OnlinePayload.wrap(create_train_state(cfg),
+                                     StreamCursor()))
+    finally:
+        ckpt.close()
+    assert restored.cursor() == cursor
+    assert int(np.asarray(restored.fence_token)) == 0
+
+
+def test_publisher_refuses_remote_model_dir(tmp_path):
+    from deepfm_tpu.elastic.mpmd import PayloadPublisher
+
+    cfg = _tiny_cfg(str(tmp_path),
+                    run={"model_dir": "http://127.0.0.1:9/bucket/ckpt"})
+    with pytest.raises(ValueError, match="remote model_dir"):
+        PayloadPublisher(cfg)
+
+
+def test_elastic_config_validation():
+    with pytest.raises(ValueError, match="lease_ttl_secs"):
+        Config.from_dict({"elastic": {"lease_ttl_secs": 0}})
+    with pytest.raises(ValueError, match="heartbeat_interval_secs"):
+        Config.from_dict({"elastic": {"lease_ttl_secs": 4.0,
+                                      "heartbeat_interval_secs": 2.0}})
+    with pytest.raises(ValueError, match="registry_debounce_polls"):
+        Config.from_dict({"elastic": {"registry_debounce_polls": 0}})
+    with pytest.raises(ValueError, match="publish_poll_secs"):
+        Config.from_dict({"elastic": {"publish_poll_secs": 0}})
+    cfg = Config.from_dict({"elastic": {
+        "coordinator_url": "http://127.0.0.1:8600",
+        "lease_ttl_secs": 5.0, "heartbeat_interval_secs": 1.0,
+        "publisher_split": True}})
+    assert cfg.elastic.publisher_split
+    assert json.loads(json.dumps(cfg.to_dict()))  # round-trips
+
+
+def test_elastic_metrics_section_renders_from_registry(tmp_path):
+    """The `elastic` JSON section re-derives from the same deepfm_elastic_*
+    families Prometheus scrapes (the /v1/metrics discipline) — lifecycle
+    events, the reshard histogram and the drain_commit_failed counter all
+    reach the registry, not just the flight recorder."""
+    from deepfm_tpu.elastic import ElasticTrainer
+    from deepfm_tpu.online import append_segment
+
+    stream = str(tmp_path / "stream")
+    append_segment(
+        stream,
+        np.zeros(4, np.float32),
+        np.zeros((4, 3), np.int64),
+        np.zeros((4, 3), np.float32),
+        seq=0,
+    )
+    cfg = _tiny_cfg(str(tmp_path),
+                    data={"training_data_dir": stream, "batch_size": 4},
+                    elastic={"enabled": True})
+    tr = ElasticTrainer(cfg)
+    snap = tr.metrics_snapshot()
+    assert set(snap) == {"epoch", "reshards", "reshards_total",
+                         "drain_commit_failed", "steps_replayed",
+                         "frozen", "fence_refused", "lifecycle"}
+    assert snap["lifecycle"] == {} and snap["reshards"]["count"] == 0
+    tr._event("detect", epoch=0)
+    tr._m_drain_failed.inc()
+    tr._m_reshard.observe(0.25)
+    snap = tr.metrics_snapshot()
+    assert snap["lifecycle"] == {"detect": 1}
+    assert snap["drain_commit_failed"] == 1
+    assert snap["reshards"]["count"] == 1
+    # and the same families render in Prometheus exposition
+    text = tr.metrics.render_prometheus()
+    assert "deepfm_elastic_drain_commit_failed_total 1" in text
+    assert 'deepfm_elastic_lifecycle_total{kind="detect"} 1' in text
+
+
+def test_multiprocess_refusal_names_the_coordinator(monkeypatch, tmp_path):
+    """Without a coordinator, >1 process still refuses — but the error
+    now points at the multi-host composition instead of a dead end."""
+    import jax
+
+    from deepfm_tpu.elastic import ElasticTrainer
+
+    cfg = _tiny_cfg(str(tmp_path),
+                    data={"training_data_dir": str(tmp_path / "s"),
+                          "batch_size": 4},
+                    elastic={"enabled": True})
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    with pytest.raises(ValueError, match="coordinator_url"):
+        ElasticTrainer(cfg)

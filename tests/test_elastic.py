@@ -96,33 +96,79 @@ def test_virtual_registry_epoch_and_membership():
         reg.fail(99)
 
 
+class _StubBackend:
+    def __init__(self, devs):
+        self.devs = devs
+
+    def devices(self):
+        if self.devs is None:
+            raise RuntimeError("slice collapsed")
+        return self.devs
+
+
 def test_live_registry_polls_backend_liveness():
     from deepfm_tpu.elastic import LiveDeviceRegistry
 
-    reg = LiveDeviceRegistry()
+    reg = LiveDeviceRegistry(debounce_polls=1)  # immediate-signal mode
     base = reg.devices()
     assert reg.poll() == 0  # unchanged membership: no epoch bump
 
-    class _Stub:
-        def __init__(self, devs):
-            self.devs = devs
-
-        def devices(self):
-            if self.devs is None:
-                raise RuntimeError("slice collapsed")
-            return self.devs
-
-    reg._jax = _Stub(list(base[:2]))
+    reg._jax = _StubBackend(list(base[:2]))
     assert reg.poll() == 1
     assert reg.devices() == tuple(base[:2])
     # the query itself failing IS a membership signal; the last good
     # list survives so drain/commit can still run on surviving state
-    reg._jax = _Stub(None)
+    reg._jax = _StubBackend(None)
     assert reg.poll() == 2
     assert reg.devices() == tuple(base[:2])
-    reg._jax = _Stub(list(base))
+    reg._jax = _StubBackend(list(base))
     epoch, devices = reg.snapshot()  # snapshot() polls
     assert epoch == 3 and devices == tuple(base)
+
+
+def test_live_registry_debounces_transient_poll_failures():
+    """One anomalous poll must NOT bump the epoch (a transient device-
+    query hiccup would otherwise cost a full drain/commit/reshard/publish
+    cycle); the same changed reading held for debounce_polls consecutive
+    polls must."""
+    from deepfm_tpu.elastic import LiveDeviceRegistry
+
+    reg = LiveDeviceRegistry()  # default debounce_polls=2
+    base = reg.devices()
+
+    # transient: one failing poll, then the backend recovers — no bump
+    reg._jax = _StubBackend(None)
+    assert reg.poll() == 0
+    reg._jax = _StubBackend(list(base))
+    assert reg.poll() == 0
+    assert reg.devices() == tuple(base)
+
+    # flapping between two DIFFERENT anomalous readings never confirms
+    reg._jax = _StubBackend(list(base[:2]))
+    assert reg.poll() == 0
+    reg._jax = _StubBackend(None)
+    assert reg.poll() == 0
+    reg._jax = _StubBackend(list(base))
+    assert reg.poll() == 0
+
+    # a real loss: the SAME changed reading on two consecutive polls
+    reg._jax = _StubBackend(list(base[:2]))
+    assert reg.poll() == 0   # first anomalous poll: pending, no signal
+    assert reg.poll() == 1   # confirmed
+    assert reg.devices() == tuple(base[:2])
+
+    # a real query blackout (raising twice) also confirms
+    reg._jax = _StubBackend(None)
+    assert reg.poll() == 1
+    assert reg.poll() == 2
+    assert reg.devices() == tuple(base[:2])  # last good list survives
+
+
+def test_live_registry_debounce_validation():
+    from deepfm_tpu.elastic import LiveDeviceRegistry
+
+    with pytest.raises(ValueError, match="debounce_polls"):
+        LiveDeviceRegistry(debounce_polls=0)
 
 
 # ---------------------------------------------------------- mesh policy
