@@ -350,9 +350,10 @@ def _run_and_measure(
     from deepfm_tpu.online.stream import StreamCursor
     from deepfm_tpu.online.trainer import commit_payload
 
-    # trainer and publisher hold DISTINCT tokens (the coordinator issues
-    # one per member), so derive each root's stale token from the mark
-    # that root actually recorded
+    # the trainer COHORT and the publisher hold distinct tokens (one
+    # shared token per epoch cohort, one per publisher incarnation), so
+    # derive each root's stale token from the mark that root actually
+    # recorded
     stale_ckpt = read_fence(cfg.run.model_dir) - 1
     stale_pub = read_fence(cfg.run.servable_model_dir) - 1
     commit_refused = publish_refused = False

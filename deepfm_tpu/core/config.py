@@ -442,7 +442,9 @@ class ElasticConfig:
     # refused, not just discouraged).
     coordinator_url: str = ""
     # lease TTL: a process that misses heartbeats for this long is expired
-    # from consensus (its devices drop out, its fencing token goes stale)
+    # from consensus (its devices drop out, its fencing token goes stale).
+    # Sent in the acquire request; the coordinator honors it clamped to
+    # its own --lease-ttl ceiling, and the granted value drives expiry.
     lease_ttl_secs: float = 10.0
     # heartbeat cadence (must leave headroom under the TTL; transitions
     # and view changes heartbeat immediately regardless)
@@ -470,15 +472,21 @@ class ElasticConfig:
                 f"elastic.prefer_model_parallel must be >= 0 (0 = "
                 f"mesh.model_parallel), got {self.prefer_model_parallel}"
             )
-        if self.lease_ttl_secs <= 0:
+        import math
+
+        # NaN slips through plain <= 0 checks and every downstream
+        # min/compare — a NaN TTL would mint a never-expiring lease
+        if not (self.lease_ttl_secs > 0
+                and math.isfinite(self.lease_ttl_secs)):
             raise ValueError(
-                f"elastic.lease_ttl_secs must be > 0, got "
+                f"elastic.lease_ttl_secs must be finite and > 0, got "
                 f"{self.lease_ttl_secs}"
             )
-        if self.heartbeat_interval_secs <= 0:
+        if not (self.heartbeat_interval_secs > 0
+                and math.isfinite(self.heartbeat_interval_secs)):
             raise ValueError(
-                f"elastic.heartbeat_interval_secs must be > 0, got "
-                f"{self.heartbeat_interval_secs}"
+                f"elastic.heartbeat_interval_secs must be finite and > 0, "
+                f"got {self.heartbeat_interval_secs}"
             )
         if self.heartbeat_interval_secs >= self.lease_ttl_secs / 2:
             raise ValueError(

@@ -149,7 +149,8 @@ class ElasticTrainer:
             pid = f"p{jax.process_index()}-{_os.getpid()}"
             self.registry = CoordinatedRegistry(
                 self.registry,
-                CoordClient(cfg.elastic.coordinator_url, pid, role="train"),
+                CoordClient(cfg.elastic.coordinator_url, pid, role="train",
+                            lease_ttl_secs=cfg.elastic.lease_ttl_secs),
                 heartbeat_interval_secs=cfg.elastic.heartbeat_interval_secs,
             )
         self._stream_root = stream_root or cfg.data.training_data_dir

@@ -28,13 +28,20 @@ Protocol (one coordinator process, N participants):
   every trainer rebuilds its mesh), then ``steady`` once all acked again.
   No process can observe the new device set while another is still
   stepping on the old one.
-* **fencing token** — every lease carries a monotone token, re-issued to
-  the survivors at each epoch flip.  The token is threaded through
-  ``commit_payload`` and ``ModelPublisher.publish`` and recorded durably
-  next to the data (:class:`Fence`); a write bearing a token older than
-  the recorded high-water mark is REFUSED.  A zombie process that missed
-  an epoch (expired lease, long GC pause, network partition) can therefore
-  not corrupt the checkpoint lineage or the publish root — the "single
+* **fencing token** — the monotone token is issued per COHORT, not per
+  member: every trainer admitted to an epoch holds the SAME token (they
+  are co-writers of one checkpoint root — replicas of one synchronous
+  program — and must be able to advance one fence without refusing each
+  other), and any trainer membership change (join, expiry, release,
+  eviction) forces an epoch flip that re-issues a strictly newer shared
+  token to the survivors.  Publishers are single writers of their own
+  root, so each publisher *incarnation* gets its own strictly-newer
+  token at acquire.  The token is threaded through ``commit_payload``
+  and ``ModelPublisher.publish`` and recorded durably next to the data
+  (:class:`Fence`); a write bearing a token older than the recorded
+  high-water mark is REFUSED.  A zombie process that missed an epoch
+  (expired lease, long GC pause, network partition) can therefore not
+  corrupt the checkpoint lineage or the publish root — the "single
   logical writer" contract becomes an enforced invariant instead of a
   ValueError at construction time.
 
@@ -188,15 +195,16 @@ def merge_views(views: dict[str, Sequence]) -> tuple:
 
 
 class _Member:
-    __slots__ = ("pid", "role", "lease_id", "token", "expires", "view",
-                 "acked_drain", "acked_reshard", "admitted_epoch")
+    __slots__ = ("pid", "role", "lease_id", "token", "expires", "ttl",
+                 "view", "acked_drain", "acked_reshard", "admitted_epoch")
 
-    def __init__(self, pid, role, lease_id, token, expires, view):
+    def __init__(self, pid, role, lease_id, token, expires, ttl, view):
         self.pid = pid
         self.role = role
         self.lease_id = lease_id
         self.token = token
         self.expires = expires
+        self.ttl = ttl
         self.view = tuple(view)
         self.acked_drain = -1
         self.acked_reshard = -1
@@ -217,7 +225,7 @@ class Coordinator:
         self,
         *,
         lease_ttl_secs: float = 10.0,
-        barrier_timeout_secs: float = 0.0,
+        barrier_timeout_secs: float = 60.0,
         clock: Callable[[], float] = time.monotonic,
         metrics: MetricsRegistry | None = None,
     ):
@@ -225,11 +233,16 @@ class Coordinator:
             raise ValueError(
                 f"lease_ttl_secs must be > 0, got {lease_ttl_secs}")
         self._ttl = float(lease_ttl_secs)
+        # drain barriers evict live-but-stuck members after this long (a
+        # DEAD member is reclaimed by the lease TTL; the timeout is the
+        # backstop for one wedged process stalling the whole pod).  0
+        # disables eviction.
         self._barrier_timeout = float(barrier_timeout_secs)
         self._clock = clock
         self._lock = threading.Lock()
         self._members: dict[str, _Member] = {}
         self._fence_counter = 0
+        self._cohort_token = 0  # the shared train-cohort token (per epoch)
         self._lease_seq = 0
         self.epoch = 0
         self.devices: tuple = ()
@@ -264,28 +277,51 @@ class Coordinator:
             self._m_expired.inc()
             obs_flight.record("coord_lease_expired", subsystem="coord",
                               pid=m.pid, role=m.role)
-        if self._barrier_timeout > 0 and self.phase == "drain" \
+        if self._barrier_timeout > 0 \
                 and self._transition_started is not None \
                 and now - self._transition_started >= self._barrier_timeout:
-            stalled = [m for m in self._trainers()
-                       if m.admitted_epoch is not None
-                       and m.acked_drain != self.transition]
+            # BOTH barriers get the backstop (the timer restarts at the
+            # flip): a wedged member that drain-acked but never reshard-
+            # acks would otherwise pin the reshard phase forever
+            if self.phase == "drain":
+                stalled = [m for m in self._trainers()
+                           if m.admitted_epoch is not None
+                           and m.acked_drain != self.transition]
+            elif self.phase == "reshard":
+                stalled = [m for m in self._trainers()
+                           if m.acked_reshard != self.transition]
+            else:
+                stalled = []
             for m in stalled:
                 del self._members[m.pid]
                 self._m_evicted.inc()
                 obs_flight.record("coord_barrier_evicted",
                                   subsystem="coord", pid=m.pid,
+                                  phase=self.phase,
                                   transition=self.transition)
             expired.extend(stalled)
         if any(m.role == "train" for m in expired):
-            self._recompute()
+            # a trainer LEFT: membership changed even if the merged device
+            # set did not, and the flip must re-issue the cohort token so
+            # the departed process's copy goes stale
+            self._recompute(force=True)
         self._refresh_gauges()
 
-    def _recompute(self) -> None:
+    def _recompute(self, *, force: bool = False) -> None:
+        """Re-derive consensus from the live trainer views.  ``force``
+        opens a transition even when the merged device set is unchanged —
+        trainer membership changes (join / expiry / release / eviction)
+        must flip the epoch so the new shared cohort token stales every
+        token held outside the new cohort.  Only a transition still in
+        its DRAIN phase needs no restart for that: its flip is ahead and
+        re-issues anyway.  In the reshard phase the flip already
+        happened, so a membership change there must restart the
+        transition or the departed process would keep a token equal to
+        the live cohort's forever."""
         merged = merge_views({m.pid: m.view for m in self._trainers()})
         target = (self.devices if self.phase == "steady"
                   else self._pending_devices)
-        if merged == target:
+        if merged == target and not (force and self.phase != "drain"):
             self._advance_barrier()
             return
         # the merged set moved: open (or restart) a transition.  Restart
@@ -308,15 +344,24 @@ class Coordinator:
                     if m.admitted_epoch is not None]
             if all(m.acked_drain == self.transition for m in need):
                 # every old-epoch trainer drained+committed: flip the
-                # epoch, expose the new set, and RE-ISSUE every live
-                # member's fencing token so anything that missed this
-                # flip holds a token the fences will refuse
+                # epoch, expose the new set, and issue ONE new cohort
+                # token shared by every live trainer — co-writers of the
+                # same checkpoint root must hold EQUAL tokens (distinct
+                # values would make each cohort member's advance fence
+                # out its peers), while anything that missed this flip
+                # holds a strictly older token the fences refuse.
+                # Publishers keep their per-incarnation acquire tokens.
                 self.epoch = self._pending_epoch
                 self.devices = tuple(self._pending_devices or ())
                 self.phase = "reshard"
+                # the reshard barrier gets its own full timeout window —
+                # a restore is legitimately slower than a drain
+                self._transition_started = self._clock()
+                self._fence_counter += 1
+                self._cohort_token = self._fence_counter
                 for m in self._members.values():
-                    self._fence_counter += 1
-                    m.token = self._fence_counter
+                    if m.role == "train":
+                        m.token = self._cohort_token
                 obs_flight.record("coord_epoch", subsystem="coord",
                                   epoch=self.epoch,
                                   devices=len(self.devices))
@@ -348,7 +393,31 @@ class Coordinator:
 
     def _lease_doc(self, m: _Member) -> dict:
         return {"lease_id": m.lease_id, "token": m.token,
-                "ttl_secs": self._ttl}
+                "ttl_secs": m.ttl}
+
+    def _grant_ttl(self, requested) -> float:
+        """The participant requests a TTL at acquire; the coordinator's
+        own ``lease_ttl_secs`` is both the default and the CEILING (a
+        shorter lease is honored, a longer one is clamped — expiry must
+        stay coordinator-bounded)."""
+        import math
+
+        if requested is None:
+            return self._ttl
+        try:
+            req = float(requested)
+        except (TypeError, ValueError):
+            # non-numeric JSON must answer 400, not tear the connection
+            raise ValueError(
+                f"ttl_secs must be a number, got {requested!r}") from None
+        # NaN passes every <=/min comparison and would mint a lease that
+        # can never TTL-expire (expires=NaN fails `expires <= now`
+        # forever), pinning its stale view in consensus — refuse anything
+        # non-finite alongside non-positive
+        if not (req > 0 and math.isfinite(req)):
+            raise ValueError(f"ttl_secs must be finite and > 0, "
+                             f"got {requested}")
+        return min(req, self._ttl)
 
     def _validate(self, pid: str, lease_id: str) -> _Member:
         m = self._members.get(pid)
@@ -358,25 +427,37 @@ class Coordinator:
 
     # -- participant API ----------------------------------------------------
     def acquire(self, pid: str, role: str = "train",
-                view: Sequence = ()) -> dict:
+                view: Sequence = (), ttl_secs: float | None = None) -> dict:
         if role not in ("train", "publish"):
             raise ValueError(f"unknown role {role!r} (train|publish)")
         with self._lock:
             self._sweep()
             self._lease_seq += 1
-            self._fence_counter += 1
+            ttl = self._grant_ttl(ttl_secs)
+            if role == "publish":
+                # one publisher per publish root: each INCARNATION gets a
+                # strictly newer token, so a replaced publisher's first
+                # advance fences its predecessor out
+                self._fence_counter += 1
+                token = self._fence_counter
+            else:
+                # trainers share the cohort token; the forced transition
+                # below re-issues a strictly newer one at its flip, which
+                # is what stales this pid's previous incarnation
+                token = self._cohort_token
             m = _Member(
                 pid=pid, role=role,
                 lease_id=f"L{self._lease_seq}-{pid}",
-                token=self._fence_counter,
-                expires=self._clock() + self._ttl,
+                token=token,
+                expires=self._clock() + ttl,
+                ttl=ttl,
                 view=view if role == "train" else (),
             )
             self._members[pid] = m  # rejoin replaces: old lease_id dies
             obs_flight.record("coord_lease_acquired", subsystem="coord",
                               pid=pid, role=role, token=m.token)
             if role == "train":
-                self._recompute()
+                self._recompute(force=True)
             else:
                 self._refresh_gauges()
             return {"lease": self._lease_doc(m),
@@ -388,7 +469,7 @@ class Coordinator:
         with self._lock:
             self._sweep()
             m = self._validate(pid, lease_id)
-            m.expires = self._clock() + self._ttl
+            m.expires = self._clock() + m.ttl
             if m.role == "train" and on_epoch is not None:
                 # the epoch this member is TRAINING ON: a member that
                 # joined an already-steady consensus registers here, so
@@ -406,7 +487,7 @@ class Coordinator:
         with self._lock:
             self._sweep()
             m = self._validate(pid, lease_id)
-            m.expires = self._clock() + self._ttl
+            m.expires = self._clock() + m.ttl
             if transition == self.transition:
                 if phase == "drain":
                     m.acked_drain = transition
@@ -427,7 +508,7 @@ class Coordinator:
                 obs_flight.record("coord_lease_released",
                                   subsystem="coord", pid=pid, role=m.role)
                 if m.role == "train":
-                    self._recompute()
+                    self._recompute(force=True)
                 self._refresh_gauges()
             return {"consensus": self._consensus()}
 
@@ -437,9 +518,11 @@ class Coordinator:
             return {
                 "consensus": self._consensus(),
                 "fence_counter": self._fence_counter,
+                "cohort_token": self._cohort_token,
                 "members": {
                     pid: {
                         "role": m.role, "token": m.token,
+                        "ttl_secs": m.ttl,
                         "view": list(m.view),
                         "expires_in_secs": round(
                             m.expires - self._clock(), 3),
@@ -540,7 +623,8 @@ def _make_handler(coord: Coordinator, plan):
                         return
                     return self._send(200, coord.acquire(
                         pid, role=req.get("role", "train"),
-                        view=req.get("view", ())))
+                        view=req.get("view", ()),
+                        ttl_secs=req.get("ttl_secs")))
                 if self.path == "/v1/lease/heartbeat":
                     if self._fault("HEARTBEAT", pid):
                         return
@@ -619,6 +703,7 @@ class CoordClient:
         pid: str,
         *,
         role: str = "train",
+        lease_ttl_secs: float | None = None,
         timeout_secs: float = 5.0,
         retry: RetryPolicy | None = None,
         breaker: CircuitBreaker | None = None,
@@ -626,6 +711,10 @@ class CoordClient:
         self.url = url.rstrip("/")
         self.pid = pid
         self.role = role
+        # requested at acquire; the coordinator grants it clamped to its
+        # own --lease-ttl ceiling, and granted_ttl records the answer
+        self.lease_ttl_secs = lease_ttl_secs
+        self.granted_ttl: float | None = None
         self._timeout = timeout_secs
         self._retry = retry or RetryPolicy(
             max_attempts=2, base_delay_secs=0.05, max_delay_secs=0.5)
@@ -669,16 +758,36 @@ class CoordClient:
         self.breaker.record_success()
         return out
 
+    def clamp_interval(self, interval: float, *, event: str) -> float:
+        """Shrink a heartbeat cadence to fit the GRANTED lease TTL.  The
+        config validated the cadence against the *requested* TTL, but the
+        coordinator may clamp the grant below it — left alone, every
+        lease would expire before its next heartbeat (a silent perpetual
+        expire/self-fence/re-acquire livelock).  Flight-records ``event``
+        once per shrink."""
+        granted = self.granted_ttl
+        if granted is None or interval < granted / 2:
+            return interval
+        clamped = granted / 4
+        obs_flight.record(event, subsystem="elastic", pid=self.pid,
+                          granted_ttl=granted, interval=interval,
+                          clamped_to=clamped)
+        return clamped
+
     def _adopt(self, resp: dict) -> dict:
         lease = resp.get("lease") or {}
         self.lease_id = lease.get("lease_id", self.lease_id)
         if lease.get("token") is not None:
             self.token = int(lease["token"])
+        if lease.get("ttl_secs") is not None:
+            self.granted_ttl = float(lease["ttl_secs"])
         return resp
 
     def acquire(self, view: Sequence = ()) -> dict:
-        return self._adopt(self._post("/v1/lease/acquire", {
-            "pid": self.pid, "role": self.role, "view": list(view)}))
+        doc = {"pid": self.pid, "role": self.role, "view": list(view)}
+        if self.lease_ttl_secs is not None:
+            doc["ttl_secs"] = float(self.lease_ttl_secs)
+        return self._adopt(self._post("/v1/lease/acquire", doc))
 
     def heartbeat(self, view: Sequence | None = None,
                   on_epoch: int | None = None) -> dict:
@@ -745,6 +854,7 @@ class CoordinatedRegistry:
         self._lock = threading.Lock()
         base = getattr(local, "_base", None) or local.devices()
         self._by_id = {d.id: d for d in base}
+        self._unmappable: tuple = ()  # consensus ids we cannot address
         self._epoch = 0
         self._devices: tuple = ()
         self._phase = "steady"
@@ -752,7 +862,8 @@ class CoordinatedRegistry:
         self._pending_epoch: int | None = None
         self._last_hb = -float("inf")
         self._last_view: tuple | None = None
-        self._drained_for: int | None = None  # transition we acked drain on
+        self._drained = False                 # controller has drained
+        self._drained_for: int | None = None  # transition the ack LANDED on
         self._on_epoch: int | None = None     # epoch we built a topology on
         self.frozen = False
         self.fenced = False
@@ -764,12 +875,36 @@ class CoordinatedRegistry:
         poll = getattr(self._local, "poll", None)
         if poll is not None:
             poll()
-        return tuple(d.id for d in self._local.devices())
+        devs = self._local.devices()
+        # refresh the id->object map from the LIVE view every poll: a
+        # runtime reinit (e.g. on grow) can mint device ids that did not
+        # exist at construction, and a died device's stale object must
+        # not be handed to a mesh build
+        self._by_id = {d.id: d for d in devs}
+        return tuple(d.id for d in devs)
 
     def _to_devices(self, ids: Sequence) -> tuple:
-        return tuple(self._by_id[i] for i in ids if i in self._by_id)
+        missing = tuple(i for i in ids if i not in self._by_id)
+        if missing:
+            # the consensus names a device this process cannot address
+            # (it lost one while frozen, or the runtime re-inventoried).
+            # Building a SMALLER mesh than the consensus — and than the
+            # peers — would silently diverge the pod; report NOTHING so
+            # the controller sits in its capacity wait until the view is
+            # heard and a new consensus forms.
+            if missing != self._unmappable:
+                self._unmappable = missing
+                obs_flight.record(
+                    "elastic_consensus_unmappable", subsystem="elastic",
+                    pid=self._client.pid, missing=list(missing),
+                    epoch=self._epoch)
+            return ()
+        self._unmappable = ()
+        return tuple(self._by_id[i] for i in ids)
 
     def _adopt_consensus(self, resp: dict) -> None:
+        self._interval = self._client.clamp_interval(
+            self._interval, event="elastic_heartbeat_clamped")
         while True:
             c = resp["consensus"]
             self._epoch = int(c["epoch"])
@@ -782,17 +917,22 @@ class CoordinatedRegistry:
                 self.frozen = False
                 obs_flight.record("elastic_thawed", subsystem="elastic",
                                   pid=self._client.pid, epoch=self._epoch)
-            # a barrier restarted while we sat drained in the capacity
-            # wait: we are STILL drained (the controller is blocked), so
-            # re-ack and adopt the response
-            if (self._phase == "drain" and self._drained_for is not None
+            # we have drained but the coordinator has not recorded it for
+            # the CURRENT transition — either the barrier restarted while
+            # we sat in the capacity wait, or our ack RPC failed and this
+            # is the first call to get through since.  Re-ack; only a
+            # SUCCESSFUL ack records _drained_for, so a transient ack
+            # failure is retried by every later heartbeat instead of
+            # stalling the whole pod's barrier.
+            if (self._phase == "drain" and self._drained
                     and self._drained_for != self._transition):
-                self._drained_for = self._transition
+                t = self._transition
                 try:
-                    resp = self._client.ack("drain", self._transition)
-                    continue
+                    resp = self._client.ack("drain", t)
                 except (CoordUnreachableError, LeaseExpired):
                     return  # the normal poll paths will retry / self-fence
+                self._drained_for = t
+                continue
             return
 
     def _heartbeat(self, *, force: bool = False) -> None:
@@ -818,6 +958,7 @@ class CoordinatedRegistry:
                         "elastic_readmitted", subsystem="elastic",
                         pid=self._client.pid,
                         token=self._client.token)
+                self._drained = False
                 self._drained_for = None
             else:
                 # on_epoch registers the epoch this process TRAINS ON —
@@ -877,19 +1018,29 @@ class CoordinatedRegistry:
     # -- controller barrier hooks -------------------------------------------
     def ack_drain(self) -> None:
         with self._lock:
+            # _drained marks the LOCAL fact (the controller finished its
+            # in-flight step); _drained_for is only set once the ack RPC
+            # SUCCEEDS — if it fails here, every later successful
+            # heartbeat re-acks (_adopt_consensus), so one transient
+            # network failure cannot leave the coordinator waiting on an
+            # ack that will never be resent
+            self._drained = True
+            t = self._transition
             try:
-                self._drained_for = self._transition
-                self._adopt_consensus(
-                    self._client.ack("drain", self._transition))
+                resp = self._client.ack("drain", t)
             except (CoordUnreachableError, LeaseExpired):
                 # frozen/fenced paths pick this up on the next poll; the
                 # barrier cannot open without us, so no one reshards early
                 self._heartbeat(force=True)
+                return
+            self._drained_for = t
+            self._adopt_consensus(resp)
 
     def ack_topology(self, epoch: int) -> None:
         """The controller built (or rebuilt) a topology for ``epoch`` —
         complete the reshard barrier if one is pending for it."""
         with self._lock:
+            self._drained = False
             self._drained_for = None
             self._on_epoch = int(epoch)
             if self._phase != "reshard" or epoch != self._epoch:
@@ -914,8 +1065,14 @@ def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8600)
-    ap.add_argument("--lease-ttl", type=float, default=10.0)
-    ap.add_argument("--barrier-timeout", type=float, default=0.0)
+    ap.add_argument(
+        "--lease-ttl", type=float, default=10.0,
+        help="default AND ceiling for participant lease TTLs (an acquire "
+             "may request a shorter one)")
+    ap.add_argument(
+        "--barrier-timeout", type=float, default=60.0,
+        help="evict a live member that stalls a drain barrier this long "
+             "(0 disables; dead members are reclaimed by the TTL)")
     args = ap.parse_args()
     server, url, _coord = serve_coordinator(
         Coordinator(lease_ttl_secs=args.lease_ttl,

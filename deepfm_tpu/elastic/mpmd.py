@@ -175,7 +175,8 @@ class PayloadPublisher:
         if cfg.elastic.coordinator_url:
             self._client = CoordClient(
                 cfg.elastic.coordinator_url,
-                f"pub-{os.getpid()}", role="publish")
+                f"pub-{os.getpid()}", role="publish",
+                lease_ttl_secs=cfg.elastic.lease_ttl_secs)
         m = metrics or MetricsRegistry()
         self.metrics = m
         self._m_published = m.counter(
@@ -192,6 +193,7 @@ class PayloadPublisher:
         self._m_lag = m.gauge(
             "deepfm_publisher_lag_steps",
             "newest committed step minus newest published step")
+        self._hb_interval = cfg.elastic.heartbeat_interval_secs
         self._last_hb = -float("inf")
 
     def metrics_snapshot(self) -> dict:
@@ -218,8 +220,7 @@ class PayloadPublisher:
         if self._client is None:
             return
         now = time.monotonic()
-        interval = self.cfg.elastic.heartbeat_interval_secs
-        if now - self._last_hb < interval:
+        if now - self._last_hb < self._hb_interval:
             return
         self._last_hb = now
         prev = self._client.token
@@ -228,6 +229,8 @@ class PayloadPublisher:
                 self._client.acquire()
             else:
                 self._client.heartbeat()
+            self._hb_interval = self._client.clamp_interval(
+                self._hb_interval, event="publisher_heartbeat_clamped")
         except LeaseExpired:
             self._client.lease_id = None
             obs_flight.record("publisher_self_fenced",
@@ -345,10 +348,16 @@ class PayloadPublisher:
             elif idle_timeout_secs > 0 and last_progress is not None and (
                     time.monotonic() - last_progress >= idle_timeout_secs):
                 break
+            # the wait must honor the (possibly clamped) heartbeat
+            # cadence, not just the publish poll: a slow tailing cadence
+            # would otherwise space heartbeats past the granted TTL and
+            # re-create the expire/re-acquire livelock the clamp prevents
+            wait = poll if self._client is None \
+                else min(poll, self._hb_interval)
             if stop is not None:
-                stop.wait(poll)
+                stop.wait(wait)
             else:
-                time.sleep(poll)
+                time.sleep(wait)
         if self._client is not None:
             self._client.release()
         self._log.event("publisher_done", published=published)

@@ -119,8 +119,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--lease_ttl_secs", type=float,
-        help="coordination lease TTL — a process silent this long is "
-             "expired from consensus and its fencing token goes stale",
+        help="coordination lease TTL requested at acquire — a process "
+             "silent this long is expired from consensus and its fencing "
+             "token goes stale; the coordinator grants it clamped to its "
+             "own --lease-ttl ceiling",
     )
     p.add_argument(
         "--serve_tenants",

@@ -111,27 +111,41 @@ def test_two_trainers_drain_barrier_holds_until_all_ack():
     la = ra["lease"]["lease_id"]
     t1 = ra["consensus"]["transition"]
     co.ack("pA", la, "reshard", t1)
+    # a trainer JOIN is a membership change even with an identical view:
+    # the old cohort drains so the flip can issue the new SHARED cohort
+    # token (B's crashed predecessor, if any, goes stale at that flip)
     rb = co.acquire("pB", view=[0, 1, 2, 3, 4, 5, 6, 7])
     lb = rb["lease"]["lease_id"]
-    # same view: joining does not open a transition
-    assert rb["consensus"]["phase"] == "steady"
-    # B registers the epoch it trains on through its heartbeat
-    co.heartbeat("pB", lb, on_epoch=1)
+    c = rb["consensus"]
+    assert c["phase"] == "drain" and c["pending_epoch"] == 2
+    r = co.ack("pA", la, "drain", c["transition"])
+    c = r["consensus"]
+    assert c["phase"] == "reshard" and c["epoch"] == 2
+    # ONE cohort token, shared: both trainers can advance the same
+    # checkpoint-root fence without refusing each other
+    st = co.status()["members"]
+    assert st["pA"]["token"] == st["pB"]["token"]
+    co.ack("pA", la, "reshard", c["transition"])
+    r = co.ack("pB", lb, "reshard", c["transition"])
+    assert r["consensus"]["phase"] == "steady"
 
     # A loses a slice: transition opens, and the new device set must NOT
     # become visible before BOTH admitted trainers drained
     r = co.heartbeat("pA", la, view=[0, 1, 2, 3])
     c = r["consensus"]
-    assert c["phase"] == "drain" and c["pending_epoch"] == 2
+    assert c["phase"] == "drain" and c["pending_epoch"] == 3
     tok_a_before = r["lease"]["token"]
     r = co.ack("pA", la, "drain", c["transition"])
     assert r["consensus"]["phase"] == "drain"  # B has not drained
     r = co.ack("pB", lb, "drain", c["transition"])
     c2 = r["consensus"]
-    assert c2["phase"] == "reshard" and c2["epoch"] == 2
+    assert c2["phase"] == "reshard" and c2["epoch"] == 3
     assert c2["devices"] == [0, 1, 2, 3]  # the intersection
-    # fencing tokens re-issued to the survivors at the epoch flip
+    # one strictly newer cohort token re-issued to the survivors at the
+    # epoch flip — still EQUAL across the cohort
     assert r["lease"]["token"] > tok_a_before
+    st = co.status()["members"]
+    assert st["pA"]["token"] == st["pB"]["token"]
     co.ack("pA", la, "reshard", c2["transition"])
     r = co.ack("pB", lb, "reshard", c2["transition"])
     assert r["consensus"]["phase"] == "steady"
@@ -145,22 +159,41 @@ def test_lease_expiry_drops_member_and_stales_its_token():
     co.ack("pA", la, "reshard", ra["consensus"]["transition"])
     rb = co.acquire("pB", view=[0, 1])
     lb = rb["lease"]["lease_id"]
-    tok_b = rb["lease"]["token"]
-    co.heartbeat("pB", lb, on_epoch=1)
+    # complete B's join barrier: A drains, the flip issues the epoch-2
+    # cohort token to both
+    r = co.ack("pA", la, "drain", rb["consensus"]["transition"])
+    t2 = r["consensus"]["transition"]
+    co.ack("pA", la, "reshard", t2)
+    r = co.ack("pB", lb, "reshard", t2)
+    assert r["consensus"]["phase"] == "steady"
+    tok_b = r["lease"]["token"]
+    assert tok_b == co.status()["members"]["pA"]["token"]
 
     # B goes silent past the TTL while A keeps heartbeating
     clock.advance(6)
-    co.heartbeat("pA", la, on_epoch=1)
+    co.heartbeat("pA", la)
     clock.advance(6)
     r = co.heartbeat("pA", la)
-    # B expired: merged view unchanged ([0,1] both) -> no device change,
-    # no transition; but B's lease is gone
+    # B expired: the merged device set is unchanged ([0,1] both) but the
+    # MEMBERSHIP shrank, so a transition opens anyway — the flip must
+    # re-issue the cohort token so B's copy goes stale
+    c = r["consensus"]
+    assert c["phase"] == "drain" and c["pending_devices"] == [0, 1]
     with pytest.raises(LeaseExpired):
         co.heartbeat("pB", lb)
-    # re-admission issues a strictly newer token: the old one is stale
+    r = co.ack("pA", la, "drain", c["transition"])
+    assert r["consensus"]["phase"] == "reshard"
+    assert r["lease"]["token"] > tok_b  # B's token is now stale
+    co.ack("pA", la, "reshard", c["transition"])
+
+    # re-admission: B's join flips the epoch again, and after the flip B
+    # holds the NEW shared cohort token — strictly newer than its old one
     rb2 = co.acquire("pB", view=[0, 1])
-    assert rb2["lease"]["token"] > tok_b
-    assert r["consensus"]["epoch"] == rb2["consensus"]["epoch"]
+    c2 = rb2["consensus"]
+    assert c2["phase"] == "drain"
+    co.ack("pA", la, "drain", c2["transition"])
+    st = co.status()["members"]
+    assert st["pB"]["token"] == st["pA"]["token"] > tok_b
 
 
 def test_expiry_of_a_diverging_member_recomputes_consensus():
@@ -182,6 +215,212 @@ def test_expiry_of_a_diverging_member_recomputes_consensus():
     assert c2["pending_devices"] == [0, 1, 2, 3]
     r = co.ack("pA", la, "drain", c2["transition"])
     assert r["consensus"]["devices"] == [0, 1, 2, 3]
+
+
+def test_lease_ttl_requested_honored_and_clamped():
+    """The trainer-side lease_ttl_secs is REQUESTED at acquire and drives
+    expiry; the coordinator's own TTL is the default and the ceiling."""
+    clock = FakeClock()
+    co = Coordinator(lease_ttl_secs=10, clock=clock)
+    r = co.acquire("short", view=[0], ttl_secs=4)
+    assert r["lease"]["ttl_secs"] == 4
+    r2 = co.acquire("pub", role="publish", ttl_secs=50)
+    assert r2["lease"]["ttl_secs"] == 10  # clamped to the ceiling
+    r3 = co.acquire("dflt", role="publish")
+    assert r3["lease"]["ttl_secs"] == 10
+    clock.advance(5)
+    # the GRANTED ttl expires the lease, not the coordinator default
+    with pytest.raises(LeaseExpired):
+        co.heartbeat("short", r["lease"]["lease_id"])
+    co.heartbeat("pub", r2["lease"]["lease_id"])  # 5s < granted 10s
+    with pytest.raises(ValueError, match="ttl_secs"):
+        co.acquire("bad", view=[0], ttl_secs=0)
+    # NaN passes <=/min comparisons and would mint a NEVER-expiring lease
+    # whose stale view pins consensus forever
+    with pytest.raises(ValueError, match="ttl_secs"):
+        co.acquire("bad", view=[0], ttl_secs=float("nan"))
+    # non-numeric JSON must surface as ValueError (HTTP 400), not a
+    # TypeError that tears the connection mid-request
+    with pytest.raises(ValueError, match="ttl_secs"):
+        co.acquire("bad", view=[0], ttl_secs=[5])
+
+
+def test_barrier_timeout_evicts_a_stalled_member():
+    """A LIVE member that heartbeats but never drain-acks must not stall
+    the pod forever: past barrier_timeout_secs it is evicted and the
+    transition re-targets the survivors."""
+    clock = FakeClock()
+    co = Coordinator(lease_ttl_secs=10, barrier_timeout_secs=30,
+                     clock=clock)
+    ra = co.acquire("pA", view=[0, 1])
+    la = ra["lease"]["lease_id"]
+    co.ack("pA", la, "reshard", ra["consensus"]["transition"])
+    rb = co.acquire("pB", view=[0, 1])
+    lb = rb["lease"]["lease_id"]
+    r = co.ack("pA", la, "drain", rb["consensus"]["transition"])
+    t = r["consensus"]["transition"]
+    co.ack("pA", la, "reshard", t)
+    co.ack("pB", lb, "reshard", t)
+
+    # shrink opens a drain barrier; B heartbeats (lease alive) but is
+    # wedged and never acks
+    r = co.heartbeat("pA", la, view=[0])
+    t = r["consensus"]["transition"]
+    co.ack("pA", la, "drain", t)
+    for _ in range(7):
+        clock.advance(4)
+        co.heartbeat("pA", la)
+        co.heartbeat("pB", lb)  # lease alive, ack never sent
+    assert co.phase == "drain"  # held at t=28 < timeout
+    clock.advance(4)            # t=32: past the timeout
+    r = co.heartbeat("pA", la)  # sweep evicts B; A already acked -> flip
+    c = r["consensus"]
+    assert c["phase"] == "reshard" and c["devices"] == [0]
+    with pytest.raises(LeaseExpired):
+        co.heartbeat("pB", lb)
+    r = co.ack("pA", la, "reshard", c["transition"])
+    assert r["consensus"]["phase"] == "steady"
+
+
+def test_membership_change_during_reshard_restales_tokens():
+    """A trainer leaving (or rejoining) BETWEEN the epoch flip and the
+    reshard barrier closing must still force a transition: the flip of
+    that transition is the only thing that re-issues the cohort token,
+    and without it the departed process would keep a token EQUAL to the
+    live cohort's forever — the fence would accept its writes."""
+    clock = FakeClock()
+    co = Coordinator(lease_ttl_secs=10, clock=clock)
+    ra = co.acquire("pA", view=[0, 1])
+    la = ra["lease"]["lease_id"]
+    co.ack("pA", la, "reshard", ra["consensus"]["transition"])
+    rb = co.acquire("pB", view=[0, 1])
+    r = co.ack("pA", la, "drain", rb["consensus"]["transition"])
+    assert r["consensus"]["phase"] == "reshard"  # flipped, B not acked
+    tok = co.status()["members"]["pB"]["token"]
+    assert co.status()["members"]["pA"]["token"] == tok
+
+    # B expires DURING the reshard phase, without ever acking
+    clock.advance(6)
+    co.heartbeat("pA", la)
+    clock.advance(6)
+    r = co.heartbeat("pA", la)
+    c = r["consensus"]
+    assert c["phase"] == "drain"  # membership change restarted the barrier
+    r = co.ack("pA", la, "drain", c["transition"])
+    assert r["consensus"]["phase"] == "reshard"
+    # the flip re-issued the cohort token: B's copy is now stale
+    assert r["lease"]["token"] > tok
+
+
+def test_barrier_timeout_evicts_a_member_stalled_in_reshard():
+    """The eviction backstop covers the RESHARD barrier too: a member
+    that drain-acked and then wedged (lease alive, reshard ack never
+    sent) must not pin the coordinator in the reshard phase forever."""
+    clock = FakeClock()
+    co = Coordinator(lease_ttl_secs=10, barrier_timeout_secs=30,
+                     clock=clock)
+    ra = co.acquire("pA", view=[0, 1])
+    la = ra["lease"]["lease_id"]
+    co.ack("pA", la, "reshard", ra["consensus"]["transition"])
+    rb = co.acquire("pB", view=[0, 1])
+    lb = rb["lease"]["lease_id"]
+    r = co.ack("pA", la, "drain", rb["consensus"]["transition"])
+    t = r["consensus"]["transition"]
+    co.ack("pA", la, "reshard", t)
+    co.ack("pB", lb, "reshard", t)
+
+    # shrink: both drain, the epoch flips, A reshard-acks — B wedges
+    r = co.heartbeat("pA", la, view=[0])
+    t = r["consensus"]["transition"]
+    co.ack("pA", la, "drain", t)
+    r = co.ack("pB", lb, "drain", t)
+    assert r["consensus"]["phase"] == "reshard"
+    co.ack("pA", la, "reshard", t)
+    for _ in range(7):
+        clock.advance(4)
+        co.heartbeat("pA", la)
+        co.heartbeat("pB", lb)  # lease alive, reshard ack never sent
+    assert co.phase == "reshard"  # held at t=28 < timeout
+    clock.advance(4)            # past the reshard barrier's own window
+    r = co.heartbeat("pA", la)  # sweep evicts B -> barrier restarts
+    c = r["consensus"]
+    assert c["phase"] == "drain"
+    with pytest.raises(LeaseExpired):
+        co.heartbeat("pB", lb)
+    r = co.ack("pA", la, "drain", c["transition"])
+    assert r["consensus"]["phase"] == "reshard"
+    assert r["consensus"]["devices"] == [0]
+    r = co.ack("pA", la, "reshard", c["transition"])
+    assert r["consensus"]["phase"] == "steady"
+
+
+def test_clamped_ttl_adapts_heartbeat_cadence(tmp_path):
+    """If the coordinator clamps the granted TTL below the configured
+    heartbeat headroom, the clients must shrink their cadence to fit the
+    grant — otherwise every lease expires before its next heartbeat and
+    the pod livelocks through expire/self-fence/re-acquire cycles."""
+    from deepfm_tpu.elastic.mpmd import PayloadPublisher
+    from deepfm_tpu.obs import flight as obs_flight
+    from deepfm_tpu.obs.flight import FlightRecorder
+
+    server, url, co = serve_coordinator(Coordinator(lease_ttl_secs=1.0))
+    prev = obs_flight.set_recorder(FlightRecorder(64))
+    try:
+        loc = VirtualDeviceRegistry(_devs(0, 1, 2, 3))
+        reg = CoordinatedRegistry(
+            loc, CoordClient(url, "p0", lease_ttl_secs=10.0),
+            heartbeat_interval_secs=2.0)
+        reg.snapshot()  # acquire: granted 1.0s < 2 * interval
+        assert reg._client.granted_ttl == 1.0
+        assert reg._interval == 0.25  # granted / 4
+        assert obs_flight.get_recorder().events(
+            kind="elastic_heartbeat_clamped")
+
+        cfg = _tiny_cfg(str(tmp_path),
+                        elastic={"coordinator_url": url,
+                                 "lease_ttl_secs": 10.0,
+                                 "heartbeat_interval_secs": 4.0})
+        pub = PayloadPublisher(cfg)
+        pub._lease_tick()
+        assert pub._hb_interval == 0.25
+        assert obs_flight.get_recorder().events(
+            kind="publisher_heartbeat_clamped")
+    finally:
+        obs_flight.set_recorder(prev)
+        server.shutdown()
+        server.server_close()
+
+
+def test_publisher_run_loop_heartbeats_under_clamped_ttl(tmp_path):
+    """The run loop's wait must honor the (clamped) heartbeat cadence,
+    not just publish_poll_secs: a slow tailing poll would otherwise
+    space heartbeats past the granted TTL and expire every lease."""
+    import time as _time
+
+    from deepfm_tpu.elastic.mpmd import PayloadPublisher
+
+    server, url, co = serve_coordinator(Coordinator(lease_ttl_secs=1.0))
+    stop = threading.Event()
+    t = None
+    try:
+        cfg = _tiny_cfg(str(tmp_path),
+                        elastic={"coordinator_url": url,
+                                 "lease_ttl_secs": 10.0,
+                                 "heartbeat_interval_secs": 4.0,
+                                 "publish_poll_secs": 30.0})
+        pub = PayloadPublisher(cfg)
+        t = threading.Thread(target=lambda: pub.run(stop=stop),
+                             daemon=True)
+        t.start()
+        _time.sleep(1.6)  # > granted 1.0s TTL: only live heartbeats
+        assert pub._hb_interval == 0.25  # clamped to granted / 4
+        assert pub._client.pid in co.status()["members"]  # never expired
+    finally:
+        stop.set()
+        if t is not None:
+            t.join(timeout=10)
+        server.shutdown()
+        server.server_close()
 
 
 def test_barrier_restart_invalidates_stale_acks():
@@ -261,16 +500,27 @@ def test_coordinated_registries_agree_and_reshard_together():
                                     heartbeat_interval_secs=0.0)
         e_a, d_a = reg_a.snapshot()
         reg_a.ack_topology(e_a)
-        e_b, d_b = reg_b.snapshot()
-        assert (e_a, [d.id for d in d_a]) == (e_b, [d.id for d in d_b])
-        reg_b.ack_topology(e_b)
-        reg_b.poll()  # registers on_epoch server-side
+        # B's JOIN re-forms the cohort: pending epoch, empty set for
+        # everyone until A drained, then the flip admits both with ONE
+        # shared cohort token
+        e_j, d_j = reg_b.snapshot()
+        assert e_j == e_a + 1 and d_j == ()
+        assert reg_a.poll() == e_j
+        reg_a.ack_drain()
+        e1a, d1a = reg_a.snapshot()
+        e1b, d1b = reg_b.snapshot()
+        assert (e1a, [d.id for d in d1a]) == (e1b, [d.id for d in d1b])
+        assert e1a == e_j and [d.id for d in d1a] == list(range(8))
+        reg_a.ack_topology(e1a)
+        reg_b.ack_topology(e1b)
+        assert reg_a.fence_token == reg_b.fence_token
+        tok_before = reg_a.fence_token
 
         # process A loses a slice: BOTH registries must report the same
         # pending epoch with an EMPTY device set until both drain
         loc_a.fail(4, 5, 6, 7)
         pend = reg_a.poll()
-        assert pend == e_a + 1
+        assert pend == e1a + 1
         assert reg_a.snapshot() == (pend, ())
         assert reg_b.poll() == pend
         assert reg_b.snapshot() == (pend, ())
@@ -281,8 +531,9 @@ def test_coordinated_registries_agree_and_reshard_together():
         e2b, d2b = reg_b.snapshot()
         assert e2a == e2b == pend
         assert [d.id for d in d2a] == [d.id for d in d2b] == [0, 1, 2, 3]
-        tok_a, tok_b = reg_a.fence_token, reg_b.fence_token
-        assert tok_a != tok_b  # one token per lease, all monotone
+        # the survivors share ONE strictly newer cohort token: co-writers
+        # of the checkpoint root must never fence each other out
+        assert reg_a.fence_token == reg_b.fence_token > tok_before
         reg_a.ack_topology(e2a)
         reg_b.ack_topology(e2b)
         assert co.phase == "steady" and co.epoch == pend
@@ -349,6 +600,128 @@ def test_registry_self_fences_on_expiry_and_readmits():
         reg.poll()  # a heartbeat after re-admission
         member = co.status()["members"][reg._client.pid]
         assert member["admitted_epoch"] is None
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_transient_ack_failure_reacked_by_next_heartbeat():
+    """A drain ack that fails transiently must be RE-SENT by the next
+    successful call: recording the drain as acked before the RPC landed
+    left the coordinator waiting forever (heartbeats kept the lease
+    alive) — the barrier stalled the whole pod."""
+    server, url, co = serve_coordinator(Coordinator(lease_ttl_secs=30))
+    try:
+        loc = VirtualDeviceRegistry(_devs(0, 1, 2, 3))
+        reg = CoordinatedRegistry(loc, CoordClient(url, "p0"),
+                                  heartbeat_interval_secs=0.0)
+        e, _ = reg.snapshot()
+        reg.ack_topology(e)
+        loc.fail(2, 3)
+        pend = reg.poll()
+        assert pend == e + 1
+        # every ACK 503s while heartbeats still succeed
+        server.fault_plan.set_rules(
+            [{"verb": "ACK", "key": "*", "status": 503}])
+        reg.ack_drain()
+        assert co.phase == "drain"  # the coordinator never heard it
+        server.fault_plan.clear()
+        reg._client.breaker._opened_at = -1e9  # force cooldown elapsed
+        # an ORDINARY later heartbeat re-acks and the barrier opens
+        reg.poll()
+        assert co.status()["members"]["p0"]["acked_drain"] \
+            == co.transition
+        assert co.phase == "reshard"
+        e2, d2 = reg.snapshot()
+        assert e2 == pend and [d.id for d in d2] == [0, 1]
+        reg.ack_topology(e2)
+        assert co.phase == "steady"
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+class _MutableLocal:
+    """A local registry whose device inventory the test swaps wholesale —
+    the runtime-reinit case: ids that did not exist at construction."""
+
+    def __init__(self, devs):
+        self.devs = list(devs)
+
+    def devices(self):
+        return list(self.devs)
+
+
+def test_registry_refreshes_device_map_and_flags_unmappable():
+    from deepfm_tpu.obs import flight as obs_flight
+    from deepfm_tpu.obs.flight import FlightRecorder
+
+    server, url, co = serve_coordinator(Coordinator(lease_ttl_secs=30))
+    try:
+        loc = _MutableLocal(_devs(0, 1, 2, 3))
+        reg = CoordinatedRegistry(loc, CoordClient(url, "p0"),
+                                  heartbeat_interval_secs=0.0)
+        e, d = reg.snapshot()
+        reg.ack_topology(e)
+        assert [x.id for x in d] == [0, 1, 2, 3]
+        # a runtime reinit mints NEW device ids: the id->object map must
+        # refresh on poll instead of silently dropping consensus ids it
+        # never saw at construction (a smaller mesh than the peers')
+        loc.devs = _devs(0, 1, 2, 3, 8, 9)
+        pend = reg.poll()
+        assert pend == e + 1
+        reg.ack_drain()
+        e2, d2 = reg.snapshot()
+        assert [x.id for x in d2] == [0, 1, 2, 3, 8, 9]
+        reg.ack_topology(e2)
+
+        # frozen + local device loss: the cached consensus names id 3,
+        # which this process can no longer address — report NOTHING (the
+        # controller sits in its capacity wait) instead of building a
+        # divergent mesh, and flight-record the gap
+        prev = obs_flight.set_recorder(FlightRecorder(64))
+        try:
+            server.fault_plan.set_rules(
+                [{"verb": "*", "key": "*", "status": 503}])
+            loc.devs = _devs(0, 1, 2, 8, 9)
+            assert reg.poll() == e2  # frozen: cached consensus epoch
+            assert reg.frozen
+            assert reg.snapshot()[1] == ()
+            events = obs_flight.get_recorder().events(
+                kind="elastic_consensus_unmappable")
+            assert events and events[-1]["missing"] == [3]
+        finally:
+            obs_flight.set_recorder(prev)
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_config_lease_ttl_reaches_the_coordinator(tmp_path):
+    """elastic.lease_ttl_secs (and the --lease_ttl_secs flag mapping to
+    it) must actually reach the coordinator: the acquire REQUESTS it and
+    the granted lease runs on it, not on the coordinator's default."""
+    import os as _os
+
+    from deepfm_tpu.elastic import ElasticTrainer
+
+    server, url, co = serve_coordinator(Coordinator(lease_ttl_secs=30))
+    try:
+        stream = str(tmp_path / "stream")
+        _os.makedirs(stream, exist_ok=True)
+        cfg = _tiny_cfg(
+            str(tmp_path),
+            data={"training_data_dir": stream, "batch_size": 4},
+            elastic={"enabled": True, "coordinator_url": url,
+                     "lease_ttl_secs": 5.0,
+                     "heartbeat_interval_secs": 1.0},
+        )
+        tr = ElasticTrainer(cfg)
+        tr.registry.poll()  # acquires the lease
+        member = co.status()["members"][tr.registry._client.pid]
+        assert member["ttl_secs"] == 5.0
+        assert tr.registry._client.granted_ttl == 5.0
+        tr.registry.release()
     finally:
         server.shutdown()
         server.server_close()
@@ -437,6 +810,84 @@ def test_publish_fence_enforced_and_recorded(tmp_path):
         pub.publish(cfg, state, fence=Fence(root, 2, holder="zombie"))
     assert list_versions(root) == [1]  # nothing was committed
     assert read_manifest(root, 1).extra["fence_token"] == 3
+
+
+def test_two_trainers_share_one_model_dir_fence(tmp_path):
+    """THE multi-trainer fencing regression: coordinated trainers all
+    fence the SAME model_dir root.  With per-member tokens (distinct
+    values at acquire and at every flip), whichever member advanced the
+    fence last staled its peers — every trainer except the highest-token
+    one crashed with StaleFencingTokenError at startup or right after
+    the first reshard.  Cohort tokens are EQUAL, so co-members advance
+    and commit interchangeably; only a writer that missed the epoch flip
+    is refused."""
+    from deepfm_tpu.checkpoint import make_checkpointer
+    from deepfm_tpu.online.stream import StreamCursor
+    from deepfm_tpu.online.trainer import commit_payload
+    from deepfm_tpu.train.step import create_train_state
+
+    server, url, co = serve_coordinator(Coordinator(lease_ttl_secs=30))
+    ckpt = None
+    try:
+        loc_a = VirtualDeviceRegistry(_devs(0, 1, 2, 3, 4, 5, 6, 7))
+        loc_b = VirtualDeviceRegistry(_devs(0, 1, 2, 3, 4, 5, 6, 7))
+        reg_a = CoordinatedRegistry(loc_a, CoordClient(url, "pA"),
+                                    heartbeat_interval_secs=0.0)
+        reg_b = CoordinatedRegistry(loc_b, CoordClient(url, "pB"),
+                                    heartbeat_interval_secs=0.0)
+        e, _ = reg_a.snapshot()
+        reg_a.ack_topology(e)
+        reg_b.snapshot()  # B joins -> the cohort re-forms
+        reg_a.poll()
+        reg_a.ack_drain()
+        e1, _ = reg_a.snapshot()
+        reg_a.ack_topology(e1)
+        e1b, _ = reg_b.snapshot()
+        reg_b.ack_topology(e1b)
+        assert reg_a.fence_token == reg_b.fence_token
+
+        cfg = _tiny_cfg(str(tmp_path))
+        root = cfg.run.model_dir
+        state = create_train_state(cfg)
+        ckpt = make_checkpointer(root)
+        # both members take ownership (_admit's fence.advance) and then
+        # commit, in any order — the exact sequence that crashed under
+        # per-member tokens
+        Fence(root, reg_b.fence_token, holder="pB").advance()
+        commit_payload(ckpt, state, StreamCursor(),
+                       fence=Fence(root, reg_a.fence_token, holder="pA"))
+        commit_payload(ckpt, state._replace(step=state.step + 1),
+                       StreamCursor(),
+                       fence=Fence(root, reg_b.fence_token, holder="pB"))
+        stale = reg_a.fence_token
+
+        # shrink -> two-phase barrier -> flip: ONE strictly newer token
+        # shared by the surviving cohort
+        loc_a.fail(4, 5, 6, 7)
+        reg_a.poll()
+        reg_b.poll()
+        reg_a.ack_drain()
+        reg_b.ack_drain()
+        e2, _ = reg_a.snapshot()
+        reg_a.ack_topology(e2)
+        e2b, _ = reg_b.snapshot()
+        reg_b.ack_topology(e2b)
+        assert reg_a.fence_token == reg_b.fence_token > stale
+
+        # the new cohort owns the root; a zombie that missed the flip is
+        # refused at the storage layer while BOTH members still commit
+        Fence(root, reg_a.fence_token, holder="pA").advance()
+        with pytest.raises(StaleFencingTokenError):
+            commit_payload(ckpt, state, StreamCursor(),
+                           fence=Fence(root, stale, holder="zombie"))
+        commit_payload(ckpt, state._replace(step=state.step + 2),
+                       StreamCursor(),
+                       fence=Fence(root, reg_b.fence_token, holder="pB"))
+    finally:
+        if ckpt is not None:
+            ckpt.close()
+        server.shutdown()
+        server.server_close()
 
 
 # ------------------------------------------------- MPMD publisher split
@@ -612,6 +1063,8 @@ def test_publisher_refuses_remote_model_dir(tmp_path):
 def test_elastic_config_validation():
     with pytest.raises(ValueError, match="lease_ttl_secs"):
         Config.from_dict({"elastic": {"lease_ttl_secs": 0}})
+    with pytest.raises(ValueError, match="lease_ttl_secs"):
+        Config.from_dict({"elastic": {"lease_ttl_secs": float("nan")}})
     with pytest.raises(ValueError, match="heartbeat_interval_secs"):
         Config.from_dict({"elastic": {"lease_ttl_secs": 4.0,
                                       "heartbeat_interval_secs": 2.0}})
