@@ -137,6 +137,8 @@ def time_step_loop(step_fn, state, batches, steps: int, batch_size: int):
         "step_us": round(dt_corr / steps * 1e6, 1),
         "sync_rtt_ms": round(rtt * 1e3, 3),
         "final_loss": round(float(np.asarray(metrics["loss"]).reshape(-1)[-1]), 4),
+        # unrounded, for bit-identity comparisons (the zero-sharding pair)
+        "final_loss_exact": float(np.asarray(metrics["loss"]).reshape(-1)[-1]),
     }
 
 

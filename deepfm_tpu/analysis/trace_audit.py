@@ -54,6 +54,17 @@ not a benchmark:
   vacuously).  The per-mode expected sets live in
   :data:`EXCHANGE_CONTRACT`.
 
+* **zero-update audit** — lower the SPMD train step with the ZeRO
+  dp-sharded weight update active (``optimizer.zero_sharding``,
+  train/optimizer.zero_sharded) and hold it to its traffic contract:
+  dense grads REDUCE-SCATTER over the data axis (one collective per
+  param leaf — the XLA-overlappable form — classified by replica
+  groups, so the model-axis row-assembly psum never false-positives),
+  no grad-sized data-axis all-reduce survives, the fresh 1/dp param
+  windows all-gather back, every flattened moment leaf lowers with
+  1/dp-sized per-shard shapes, and the step stays
+  ``transfer_guard('disallow')``-clean with the state donated.
+
 * **funnel audit** — lower the recommendation funnel's retrieval and
   expand+rank executables (``funnel/index.py``) on the audited serve
   meshes: transfer-guard-clean at every bucket, the index rides as
@@ -587,6 +598,45 @@ EXCHANGE_CONTRACT = {
 }
 
 
+def _replica_groups(line: str) -> list[list[int]] | None:
+    """Parse a collective op's ``replica_groups = dense<[[..], ..]>``
+    attribute — the device grouping that tells WHICH mesh axis the
+    collective rides (the zero-update contract must tell a data-axis
+    grad all-reduce from the model-axis psum of the row assembly)."""
+    import re
+
+    m = re.search(r"replica_groups\s*=\s*dense<\[\[(.*?)\]\]>", line)
+    if not m:
+        return None
+    try:
+        return [
+            [int(x) for x in grp.split(",") if x.strip()]
+            for grp in m.group(1).split("], [")
+        ]
+    except ValueError:
+        return None
+
+
+def collective_axis(groups, dp: int, mp: int) -> str | None:
+    """Classify a collective's replica groups on a [dp, mp] mesh laid out
+    data-major (parallel/mesh.build_mesh): the DATA axis groups are mp
+    many, each dp devices stride mp apart; the MODEL axis groups are dp
+    many, each mp consecutive devices.  None = no groups parsed;
+    'other' = neither single axis (e.g. a both-axes collective)."""
+    if not groups:
+        return None
+    sizes = {len(g) for g in groups}
+    if sizes == {dp} and len(groups) == mp and all(
+        g[i + 1] - g[i] == mp for g in groups for i in range(len(g) - 1)
+    ):
+        return "data"
+    if sizes == {mp} and len(groups) == dp and all(
+        g[i + 1] - g[i] == 1 for g in groups for i in range(len(g) - 1)
+    ):
+        return "model"
+    return "other"
+
+
 def _tensor_shapes(line: str) -> list[tuple[int, ...]]:
     """Operand shapes from an op's `: (tensor<AxBxDT>, ...) ->` signature."""
     import re
@@ -631,6 +681,7 @@ def summarize_collectives(mlir_text: str) -> list[dict]:
             entry = {
                 "op": kind,
                 "shapes": _tensor_shapes(line),
+                "groups": _replica_groups(line),
                 "branch": (
                     (cond_stack[-1][1], cond_stack[-1][2])
                     if cond_stack else None
@@ -1670,6 +1721,282 @@ def audit_observability(cfg=None, predict_builder=None,
     return out
 
 
+# ---------------------------------------------------------------------------
+# zero-update contract (ZeRO dp-sharded weight update, train/optimizer.py +
+# parallel/spmd.py)
+
+# the mesh the contract lowers on (the flagship product mesh; the
+# bit-parity tests additionally cover [4,2])
+_ZERO_AUDIT_MESH = (2, 4)
+
+
+def check_zero_collectives(
+    mlir_text: str, *, dp: int, mp: int, n_sharded_leaves: int,
+    where: str = "deepfm_tpu/parallel/spmd.py",
+) -> list[Finding]:
+    """Hold one lowered train step to the sharded-weight-update traffic
+    contract: dense grads must REDUCE-SCATTER over the data axis (one
+    collective per param leaf, issued as each grad becomes available so
+    XLA can overlap it with the remaining backward), the fresh 1/dp param
+    windows must ALL-GATHER back, and NO >1-element all-reduce may ride
+    the data axis (the replicated grad sync the sharded update exists to
+    remove — metric scalars are exempt).  Model-axis collectives (the
+    row-assembly psum, the window bit-stability pmean) are out of scope.
+    Factored out of :func:`audit_zero_update` so the seeded-violation
+    test can feed a replicated-path (zero=off) lowering through the same
+    checks and watch it get caught."""
+    cols = summarize_collectives(mlir_text)
+    out: list[Finding] = []
+
+    def n_elems(shapes) -> int:
+        best = 0
+        for s in shapes:
+            n = 1
+            for d in s:
+                n *= d
+            best = max(best, n)
+        return best
+
+    data_ar = [
+        c for c in cols
+        if c["op"] == "all_reduce"
+        and collective_axis(c.get("groups"), dp, mp) == "data"
+        and n_elems(c["shapes"]) > 1
+    ]
+    if data_ar:
+        out.append(_finding(
+            "trace-collective",
+            f"zero-sharded train step still ALL-REDUCES {len(data_ar)} "
+            f"grad-sized tensor(s) over the data axis "
+            f"({[(c['op'], c['shapes']) for c in data_ar[:4]]}) — the "
+            f"replicated update's collective survived; the sharded "
+            f"update must reduce-scatter instead",
+            hint="raw local grads must reach the zero wrapper "
+                 "(parallel/spmd.py must not _pmean_grads when "
+                 "zero_layout is on; train/optimizer.zero_sharded)",
+            where=where, slug="zero-dense-allreduce",
+        ))
+    rs = [
+        c for c in cols
+        if c["op"] == "reduce_scatter"
+        and collective_axis(c.get("groups"), dp, mp) == "data"
+    ]
+    if len(rs) < n_sharded_leaves:
+        out.append(_finding(
+            "trace-collective",
+            f"zero-sharded train step lowers {len(rs)} data-axis "
+            f"reduce-scatter(s) for {n_sharded_leaves} sharded param "
+            f"leaves — grads are not reduce-scattered per leaf "
+            f"(per-leaf issuance is what lets XLA overlap each "
+            f"collective with the remaining backward compute)",
+            hint="lax.psum_scatter per leaf in "
+                 "train/optimizer.zero_sharded",
+            where=where, slug="zero-reduce-scatter-missing",
+        ))
+    ag = [
+        c for c in cols
+        if c["op"] == "all_gather"
+        and collective_axis(c.get("groups"), dp, mp) == "data"
+    ]
+    if len(ag) < n_sharded_leaves:
+        out.append(_finding(
+            "trace-collective",
+            f"zero-sharded train step lowers {len(ag)} data-axis "
+            f"all-gather(s) for {n_sharded_leaves} sharded param leaves "
+            f"— the fresh 1/dp param windows are not gathered back to "
+            f"full width",
+            hint="lax.all_gather of the updated windows in "
+                 "train/optimizer.zero_sharded",
+            where=where, slug="zero-allgather-missing",
+        ))
+    return out
+
+
+def check_zero_state_sharding(
+    state_shardings, state_shapes, *, dp: int,
+    where: str = "deepfm_tpu/parallel/spmd.py",
+) -> list[Finding]:
+    """The moment-residency half of the zero contract: the opt_state must
+    carry the ``zero_dp`` layout marker (train/optimizer.ZeroDpState),
+    and every flattened moment leaf must be dp-sharded — its per-shard
+    dim0 at most ``global // dp``.  A replicated moment leaf (the seeded
+    violation: full-size per-shard moments behind the zero flag) fails
+    the per-shard sizing; a plain replicated opt_state (no marker) fails
+    the marker check."""
+    import jax
+
+    out: list[Finding] = []
+    shard_leaves = jax.tree_util.tree_flatten_with_path(state_shardings)[0]
+    shape_leaves = jax.tree_util.tree_leaves(state_shapes)
+    marked = 0
+    bad: list[str] = []
+    for (path, sh), sds in zip(shard_leaves, shape_leaves):
+        if not any(getattr(p, "name", None) == "zero_dp"
+                   or getattr(p, "key", None) == "zero_dp" for p in path):
+            continue
+        shape = tuple(getattr(sds, "shape", ()))
+        # flat (1-D) leaves are the dp-partitioned layout by construction;
+        # >1-D leaves under the marker are the rare ineligible fallback
+        # (legitimately not dp-sharded) and scalars are optimizer counts
+        if len(shape) != 1 or shape[0] < dp:
+            continue
+        marked += 1
+        try:
+            per_shard = sh.shard_shape(shape)[0]
+        except (AttributeError, TypeError, ValueError, IndexError):
+            # an unreadable sharding cannot prove dp residency: treat it
+            # as replicated so the contract fails loudly below
+            per_shard = shape[0]
+        if per_shard * dp > shape[0]:
+            bad.append(
+                f"{jax.tree_util.keystr(path)}: {per_shard}/{shape[0]} "
+                f"per shard"
+            )
+    if not marked:
+        out.append(_finding(
+            "trace-collective",
+            "opt_state carries NO dp-partitioned (zero_dp) moment leaves "
+            "— the optimizer state is fully replicated across the data "
+            "axis (every shard redundantly holds and updates all "
+            "moments)",
+            hint="build the train context with optimizer.zero_sharding "
+                 "on|auto (parallel/spmd.make_context)",
+            where=where, slug="zero-moments-unsharded",
+        ))
+    elif bad:
+        out.append(_finding(
+            "trace-collective",
+            f"{len(bad)} zero-layout moment leaf(s) are NOT dp-sharded "
+            f"(per-shard size exceeds global/dp): {bad[:4]} — the "
+            f"moments are replicated despite the sharded-update layout",
+            hint="_spec_for_leaf must emit data-axis specs for zero_dp "
+                 "leaves (parallel/spmd.py)",
+            where=where, slug="zero-moments-replicated",
+        ))
+    return out
+
+
+def audit_zero_update(cfg=None, context_builder=None) -> list[Finding]:
+    """The ZeRO dp-sharded weight-update contract
+    (train/optimizer.zero_sharded + parallel/spmd.py), lowered on the
+    flagship [2,4] virtual mesh with ``optimizer.zero_sharding='on'``:
+
+    * **reduce-scatter, not all-reduce** — the lowered SPMD step carries
+      one data-axis reduce-scatter per sharded param leaf and NO
+      grad-sized data-axis all-reduce (:func:`check_zero_collectives`);
+      the fresh 1/dp param windows all-gather back;
+    * **dp-sharded moments** — every flattened moment leaf lowers with
+      1/dp-sized per-shard shapes (:func:`check_zero_state_sharding`);
+    * **transfer-guard-clean, donated** — the step lowers under
+      ``jax.transfer_guard('disallow')`` with the state donated, exactly
+      like the replicated step (the sharded update must not smuggle a
+      host staging hop or break in-place buffer reuse).
+
+    ``context_builder(cfg, mesh)`` lets the seeded-violation tests feed
+    a replicated-moments context through the same checks."""
+    import sys
+
+    import jax
+
+    if len(jax.devices()) < 8:
+        print(
+            "trace-audit: zero-update contract SKIPPED — needs >= 8 "
+            "devices (run under JAX_PLATFORMS=cpu with "
+            "--xla_force_host_platform_device_count=8; scripts/check.sh "
+            "and the analysis CLI arrange this)",
+            file=sys.stderr,
+        )
+        return []
+    from ..core.config import MeshConfig
+    from ..parallel import abstract_spmd_state, build_mesh, make_context
+    from ..parallel.spmd import TABLE_KEYS, make_spmd_train_step
+    from ..train.optimizer import zero_layout_size
+
+    dp, mp = _ZERO_AUDIT_MESH
+    where = "deepfm_tpu/parallel/spmd.py"
+    base = (cfg or _audit_cfg()).with_overrides(
+        data={"batch_size": 128},
+        optimizer={"zero_sharding": "on"},
+    )
+    mesh = build_mesh(MeshConfig(data_parallel=dp, model_parallel=mp))
+    ctx = (context_builder or make_context)(base, mesh)
+    state = abstract_spmd_state(ctx)
+    pv = ctx.cfg.model.feature_size
+
+    def _sharded_leaf(path, leaf):
+        keys = {getattr(p, "key", None) for p in path}
+        shape = tuple(leaf.shape)
+        shards = mp if (keys & set(TABLE_KEYS) and shape
+                        and shape[0] == pv) else 1
+        n = 1
+        for d in shape:
+            n *= int(d)
+        return zero_layout_size(n, shards, dp) is not None
+
+    n_sharded = sum(
+        1 for path, leaf in
+        jax.tree_util.tree_flatten_with_path(state.params)[0]
+        if _sharded_leaf(path, leaf)
+    )
+    out: list[Finding] = []
+    out.extend(check_zero_state_sharding(
+        ctx.state_shardings.opt_state, state.opt_state, dp=dp, where=where,
+    ))
+    f = ctx.cfg.model.field_size
+    b = base.data.batch_size
+    batch = {
+        "feat_ids": jax.ShapeDtypeStruct((b, f), jax.numpy.int32),
+        "feat_vals": jax.ShapeDtypeStruct((b, f), jax.numpy.float32),
+        "label": jax.ShapeDtypeStruct((b,), jax.numpy.float32),
+    }
+    step = make_spmd_train_step(ctx)  # donated — the contract checks it
+    try:
+        with jax.transfer_guard("disallow"):
+            lowered = step.lower(state, batch)
+    except Exception as e:
+        out.append(_finding(
+            "trace-transfer",
+            f"lowering the zero-sharded train step under "
+            f"transfer_guard('disallow') raised {type(e).__name__}: {e} "
+            f"— the sharded update moved host data implicitly",
+            hint="the windowed update must be pure traced code "
+                 "(train/optimizer.zero_sharded)",
+            where=where, slug="zero-transfer-guard",
+        ))
+        return out
+    out.extend(check_zero_collectives(
+        lowered.as_text(), dp=dp, mp=mp, n_sharded_leaves=n_sharded,
+        where=where,
+    ))
+    try:
+        args_info = lowered.args_info
+        state_info = args_info[0][0]
+        donated = [bool(getattr(a, "donated", False))
+                   for a in jax.tree_util.tree_leaves(state_info)]
+    except (AttributeError, IndexError, KeyError, TypeError):
+        donated = []
+    if donated and not all(donated):
+        n_bad = sum(1 for d in donated if not d)
+        out.append(_finding(
+            "trace-donation",
+            f"{n_bad}/{len(donated)} zero-sharded train-state leaves are "
+            f"NOT donated — the dp-partitioned moments would copy every "
+            f"step instead of updating in place",
+            hint="make_spmd_train_step jits with donate_argnums=(0,)",
+            where=where, slug="zero-not-donated",
+        ))
+    elif not donated:
+        out.append(_finding(
+            "trace-donation",
+            "could not read donation info from the lowered zero-sharded "
+            "train step (args_info missing) — the donation contract is "
+            "unverified",
+            hint="jax upgrade changed the AOT API; update the audit",
+            where=where, slug="zero-donation-unverified",
+        ))
+    return out
+
+
 def run_trace_audit(cfg=None) -> list[Finding]:
     """All engine-2 audits against the real entrypoints (abstract values
     only; no step executes).  Importing jax is the price of admission —
@@ -1680,6 +2007,7 @@ def run_trace_audit(cfg=None) -> list[Finding]:
     findings.extend(audit_train_step(cfg))
     findings.extend(audit_paged_step(cfg))
     findings.extend(audit_spmd_exchange(cfg))
+    findings.extend(audit_zero_update(cfg))
     findings.extend(audit_sharded_predict(cfg))
     findings.extend(audit_multitenant(cfg))
     findings.extend(audit_funnel(cfg))

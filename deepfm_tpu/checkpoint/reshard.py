@@ -133,6 +133,21 @@ def _is_table_leaf(path) -> bool:
     return bool(keys & set(TABLE_KEYS))
 
 
+def _is_zero_leaf(path) -> bool:
+    """A leaf of the ZeRO dp-partitioned optimizer state
+    (train/optimizer.ZeroDpState).  Its flattened layout is CANONICAL —
+    the row-major flatten of the param (plus trailing zero padding), see
+    ``zero_layout_size`` — so adapting between topologies is the same
+    dim0 slice/pad the table row-padding adapt already does.  The marker
+    appears as a dict key in Orbax's on-disk form and as a NamedTuple
+    attr on live states."""
+    return any(
+        getattr(p, "key", None) == "zero_dp"
+        or getattr(p, "name", None) == "zero_dp"
+        for p in path
+    )
+
+
 def _dictify(x):
     """Mirror Orbax's on-disk pytree form: NamedTuples -> field dicts
     (field-less ones -> None), tuples -> lists."""
@@ -164,6 +179,101 @@ def _undictify(template, d):
     return d
 
 
+def relayout_state(state, target_shapes, target_shardings):
+    """Re-lay a restored tree whose opt_state is in the OTHER zero-sharding
+    layout (replicated moments ↔ the flattened dp-partitioned
+    ``ZeroDpState`` layout) into ``target_shapes``/``target_shardings``.
+
+    The zero wrapper adds exactly ONE structure level around the same
+    inner optax state and flattens leaves without reordering them, so the
+    two layouts' flattened leaf orders are congruent — leaves pair by
+    position.  A pair with equal shapes re-places; a mismatched pair
+    relays through the canonical flat form (row-major flatten + trailing
+    zero padding, ``train/optimizer.zero_layout_size``): reshape, then
+    pad or slice — slicing verifies the dropped tail is all-zero padding
+    (anything else is real data and raises
+    :class:`ReshardDataLossError`).  Everything stays on-device through
+    jitted reshapes (probe-guarded like the row adapt; the host fallback
+    only engages on backends whose sharded reshape miscompiles)."""
+    src_leaves = jax.tree_util.tree_leaves(state)
+    tgt_paths = jax.tree_util.tree_flatten_with_path(target_shapes)[0]
+    tgt_def = jax.tree_util.tree_structure(target_shapes)
+    shard_leaves = jax.tree_util.tree_leaves(target_shardings)
+    if not (len(src_leaves) == len(tgt_paths) == len(shard_leaves)):
+        raise ValueError(
+            f"cannot relayout: {len(src_leaves)} source leaves vs "
+            f"{len(tgt_paths)} target leaves — the trees are not "
+            f"layout-congruent"
+        )
+    out = []
+    for s, (path, t), sh in zip(src_leaves, tgt_paths, shard_leaves):
+        if not hasattr(t, "shape") or not hasattr(s, "shape") \
+                or tuple(s.shape) == tuple(t.shape):
+            out.append(jax.device_put(s, sh) if hasattr(s, "shape") else s)
+            continue
+        n_t = 1
+        for d in t.shape:
+            n_t *= int(d)
+        n_s = int(np.prod(s.shape)) if s.shape else 1
+        if n_s > n_t:
+            dropped = bool(jax.jit(
+                lambda a, n=n_t: jnp.any(a.reshape(-1)[n:] != 0)
+            )(s))
+            if dropped:
+                raise ReshardDataLossError(
+                    f"relayout of {jax.tree_util.keystr(path)} from "
+                    f"{tuple(s.shape)} to {tuple(t.shape)} would drop "
+                    f"non-zero data — the flat tail is not padding"
+                )
+
+        def _reform(a, n=n_t, shape=tuple(t.shape)):
+            flat = a.reshape(-1)
+            if flat.shape[0] < n:
+                flat = jnp.concatenate(
+                    [flat, jnp.zeros((n - flat.shape[0],), flat.dtype)]
+                )
+            return flat[:n].reshape(shape)
+
+        if _reshape_under_sharding_ok(sh):
+            # one jitted executable cannot span two device sets: when the
+            # source lives on a different mesh (the live reshard path),
+            # stage it onto the target mesh first
+            src_devs = getattr(getattr(s, "sharding", None),
+                               "device_set", None)
+            if src_devs is not None and src_devs != sh.device_set:
+                from jax.sharding import (
+                    NamedSharding, PartitionSpec as P2,
+                )
+
+                s = jax.device_put(
+                    s, NamedSharding(sh.mesh, P2(*([None] * s.ndim)))
+                )
+            out.append(jax.jit(_reform, out_shardings=sh)(s))
+        else:
+            host = np.asarray(jax.device_get(s)).reshape(-1)
+            if host.size < n_t:
+                host = np.concatenate(
+                    [host, np.zeros((n_t - host.size,), host.dtype)]
+                )
+            out.append(jax.device_put(
+                host[:n_t].reshape(tuple(t.shape)), sh
+            ))
+    return jax.tree_util.tree_unflatten(tgt_def, out)
+
+
+def _alt_layout_context(ctx):
+    """An SPMDContext over the SAME cfg/mesh whose opt_state templates
+    describe the OTHER zero-sharding layout — the shape a payload
+    committed under a different data-parallel degree (or a pre-zero
+    framework version) actually has.  ``make_context`` re-pads the
+    already-padded vocab idempotently, so shapes line up exactly."""
+    from ..parallel.spmd import make_context
+
+    return make_context(
+        ctx.cfg, ctx.mesh, zero_layout=not ctx.zero_layout
+    )
+
+
 def restore_resharded(
     ckpt: Checkpointer,
     ctx,
@@ -182,17 +292,44 @@ def restore_resharded(
 
     Raises if a slice would drop non-zero rows (i.e. the target vocabulary
     is genuinely smaller than the data in the checkpoint).
+
+    The optimizer-state LAYOUT adapts too: a checkpoint whose moments are
+    in the other ``optimizer.zero_sharding`` layout (a legacy replicated
+    payload restoring into the dp-sharded layout, or a dp-sharded payload
+    restoring onto a dp'=1 mesh where the sharded update is inactive)
+    restores through a template of ITS layout and relays on-device
+    (:func:`relayout_state`).
     """
     from ..parallel.spmd import _build_full_init
 
     if plan is not None:
         plan.validate_target(ctx)
     # target template (shape inference only — nothing materializes)
-    init_fn = _build_full_init(ctx.cfg, ctx.true_feature_size)
+    init_fn = _build_full_init(ctx.cfg, ctx.true_feature_size,
+                               ctx.zero_layout)
     target_shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
-    return _restore_resharded_tree(
-        ckpt, target_shapes, ctx.state_shardings, step
-    )
+
+    def alt_candidate():
+        # the checkpoint may hold the OTHER opt-state layout (committed
+        # under a different dp, or by a pre-zero framework version):
+        # restore through a template of that layout, relayout on-device.
+        # Built lazily — the steady state restores under the target
+        # template and never pays this second abstract init trace.
+        alt = _alt_layout_context(ctx)
+        alt_shapes = jax.eval_shape(
+            _build_full_init(alt.cfg, alt.true_feature_size,
+                             alt.zero_layout),
+            jax.random.PRNGKey(0),
+        )
+        return (alt_shapes, alt.state_shardings,
+                lambda got: relayout_state(
+                    got, target_shapes, ctx.state_shardings))
+
+    candidates = [
+        lambda: (target_shapes, ctx.state_shardings, None),
+        alt_candidate,
+    ]
+    return _restore_resharded_tree(ckpt, candidates, step)
 
 
 def restore_resharded_payload(
@@ -216,55 +353,92 @@ def restore_resharded_payload(
 
     if plan is not None:
         plan.validate_target(ctx)
-    init_fn = _build_full_init(ctx.cfg, ctx.true_feature_size)
-    train_shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
-    target_shapes = OnlinePayload(
-        step=jax.ShapeDtypeStruct((), jnp.int32),
-        train=train_shapes,
-        cursor_segment=jax.ShapeDtypeStruct((_CURSOR_BYTES,), jnp.uint8),
-        cursor_len=jax.ShapeDtypeStruct((), jnp.int32),
-        cursor_record=jax.ShapeDtypeStruct((), jnp.int64),
-        fence_token=jax.ShapeDtypeStruct((), jnp.int64),
-    )
-    repl = NamedSharding(ctx.mesh, P())
-    shardings = OnlinePayload(
-        step=repl,
-        train=ctx.state_shardings,
-        cursor_segment=repl,
-        cursor_len=repl,
-        cursor_record=repl,
-        fence_token=repl,
-    )
-    try:
-        return _restore_resharded_tree(ckpt, target_shapes, shardings, step)
-    except ReshardDataLossError:
-        raise  # deliberate refusal, never a format question
-    except Exception as e:
-        # pre-fencing commit (no fence_token leaf): retry with the legacy
-        # payload tree and upgrade to fence_token=0 (the unfenced marker)
-        from ..online.trainer import _LegacyOnlinePayload, _upgrade_legacy
 
-        try:
-            legacy = _restore_resharded_tree(
-                ckpt,
-                _LegacyOnlinePayload(*target_shapes[:5]),
-                _LegacyOnlinePayload(*shardings[:5]),
-                step,
-            )
-        except Exception:
-            raise e from None  # the original failure is the real story
-        return _upgrade_legacy(legacy)
+    def payload_templates(c):
+        init_fn = _build_full_init(c.cfg, c.true_feature_size,
+                                   c.zero_layout)
+        train_shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+        shapes = OnlinePayload(
+            step=jax.ShapeDtypeStruct((), jnp.int32),
+            train=train_shapes,
+            cursor_segment=jax.ShapeDtypeStruct(
+                (_CURSOR_BYTES,), jnp.uint8),
+            cursor_len=jax.ShapeDtypeStruct((), jnp.int32),
+            cursor_record=jax.ShapeDtypeStruct((), jnp.int64),
+            fence_token=jax.ShapeDtypeStruct((), jnp.int64),
+        )
+        repl = NamedSharding(c.mesh, P())
+        shardings = OnlinePayload(
+            step=repl,
+            train=c.state_shardings,
+            cursor_segment=repl,
+            cursor_len=repl,
+            cursor_record=repl,
+            fence_token=repl,
+        )
+        return shapes, shardings
+
+    target_shapes, shardings = payload_templates(ctx)
+
+    # candidate templates, most-likely first: the target layout, then the
+    # OTHER opt-state layout (a payload committed under a different dp —
+    # the elastic grow/shrink across the dp==1 boundary — or by a
+    # pre-zero framework version); each also tried as the pre-fencing
+    # 5-field legacy tree.  A hit on an alternate-layout template relays
+    # on-device into the target layout (relayout_state).  All templates
+    # are tried PER STEP (newest first), so a layout mismatch never
+    # masquerades as a torn step and regresses the resume point; the
+    # alternate-layout templates build lazily (thunks) so the steady
+    # state never pays their extra abstract init trace.
+    from ..online.trainer import _LegacyOnlinePayload, _upgrade_legacy
+
+    def _relayout(got):
+        return relayout_state(got, target_shapes, shardings)
+
+    def _legacy_of(shapes_c, shards_c, post):
+        return (
+            _LegacyOnlinePayload(*shapes_c[:5]),
+            _LegacyOnlinePayload(*shards_c[:5]),
+            (lambda got, p=post: p(_upgrade_legacy(got)) if p
+             else _upgrade_legacy(got)),
+        )
+
+    alt_cache: list = []
+
+    def _alt_templates():
+        if not alt_cache:
+            alt_cache.append(payload_templates(_alt_layout_context(ctx)))
+        return alt_cache[0]
+
+    candidates = [
+        lambda: (target_shapes, shardings, None),
+        lambda: _legacy_of(target_shapes, shardings, None),
+        lambda: (*_alt_templates(), _relayout),
+        lambda: _legacy_of(*_alt_templates(), _relayout),
+    ]
+    return _restore_resharded_tree(ckpt, candidates, step)
 
 
 def _restore_resharded_tree(
-    ckpt: Checkpointer, target_shapes, target_shardings, step: int | None
+    ckpt: Checkpointer, candidates, step: int | None
 ):
     """The shared cross-topology restore engine: stream every leaf from
     the checkpoint directly INTO a sharding on the target mesh, adapting
     table-leaf row counts on-device (``jit_row_adapter``).
 
-    When no step is pinned, unreadable (torn) steps fall back to the
-    previous complete one — the same discipline as
+    ``candidates`` is a list of zero-arg thunks, each returning a
+    ``(target_shapes, target_shardings, post_fn | None)`` template,
+    tried IN ORDER at each step — the target tree first, then alternate
+    layouts (the other zero-sharding layout, the pre-fencing legacy
+    payload) whose ``post_fn`` converts the restored tree into the
+    target form.  Thunks keep the alternate templates UNBUILT on the
+    happy path (the steady state restores under the first template; the
+    alternates' extra abstract init trace is paid only after a failure).
+    All templates are exhausted at one step before falling back to an
+    older one, so a layout mismatch is never mistaken for a torn step.
+
+    When no step is pinned, steps unreadable under EVERY template fall
+    back to the previous complete one — the same discipline as
     ``online.trainer.restore_latest_payload``: a reshard triggered right
     after a commit was torn mid-write must resume from the previous
     payload, not die on the step it was hardened against."""
@@ -272,29 +446,46 @@ def _restore_resharded_tree(
 
     mngr = ckpt._mngr
     mngr.wait_until_finished()
-    if step is not None:
-        return _restore_tree_at(ckpt, target_shapes, target_shardings, step)
-    steps = sorted(mngr.all_steps(), reverse=True)
+    steps = [step] if step is not None else sorted(
+        mngr.all_steps(), reverse=True
+    )
     if not steps:
         raise FileNotFoundError("no checkpoint to restore")
-    last_err: Exception | None = None
+    step_err: Exception | None = None
+    resolved: list = [None] * len(candidates)
     for s in steps:
-        try:
-            return _restore_tree_at(
-                ckpt, target_shapes, target_shardings, s
-            )
-        except ReshardDataLossError:
-            raise  # deliberate refusal, not a torn step
-        except Exception as e:
-            last_err = e
-            logging.getLogger(__name__).warning(
-                "checkpoint step %d unreadable for resharded restore "
-                "(%s: %s) — falling back to the previous complete step",
-                s, type(e).__name__, e)
+        # per-STEP first failure (the target template's — the most
+        # representative story for THIS step); reset across steps so the
+        # fallback warnings and the terminal error never blame a failure
+        # on the wrong step
+        step_err = None
+        for i, candidate in enumerate(candidates):
+            if resolved[i] is None:
+                resolved[i] = candidate()
+            shapes_c, shards_c, post = resolved[i]
+            try:
+                got = _restore_tree_at(ckpt, shapes_c, shards_c, s)
+            except ReshardDataLossError:
+                raise  # deliberate refusal, not a torn step
+            except Exception as e:
+                step_err = step_err or e
+                continue
+            return post(got) if post else got
+        if step is not None:
+            raise RuntimeError(
+                f"checkpoint step {step} is unreadable under every "
+                f"template; first error: {type(step_err).__name__}: "
+                f"{step_err}"
+            ) from step_err
+        logging.getLogger(__name__).warning(
+            "checkpoint step %d unreadable for resharded restore under "
+            "every template (first: %s: %s) — falling back to the "
+            "previous complete step",
+            s, type(step_err).__name__, step_err)
     raise RuntimeError(
-        f"every checkpoint step {steps} is unreadable; last error: "
-        f"{type(last_err).__name__}: {last_err}"
-    ) from last_err
+        f"every checkpoint step {steps} is unreadable; last step's "
+        f"error: {type(step_err).__name__}: {step_err}"
+    ) from step_err
 
 
 def _restore_tree_at(
@@ -356,14 +547,15 @@ def _restore_tree_at(
         if tuple(m.shape) == tuple(target_sds.shape):
             return jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=sharding)
         if (
-            not _is_table_leaf(path)
+            not (_is_table_leaf(path) or _is_zero_leaf(path))
             or len(m.shape) == 0
             or tuple(m.shape[1:]) != tuple(target_sds.shape[1:])
         ):
             raise ValueError(
                 f"checkpoint leaf {jax.tree_util.keystr(path)} has shape "
                 f"{tuple(m.shape)}, target needs {tuple(target_sds.shape)} — "
-                f"only table row counts (vocab padding) can be adapted"
+                f"only table row counts (vocab padding) and dp-sharded "
+                f"zero-layout moment lengths can be adapted"
             )
         if m.shape[0] % _dim0_partitions(sharding) == 0:
             # streaming path: restore at the SAVED row count, sharded over

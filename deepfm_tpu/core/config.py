@@ -339,11 +339,30 @@ class OptimizerConfig:
     # touched-rows-only Adam for the embedding tables (train/lazy.py): the
     # TF1 sparse_apply_adam capability; Adam-only, single-controller path
     lazy_embedding_updates: bool = False
+    # ZeRO-style dp-sharded weight update (train/optimizer.zero_sharded,
+    # arxiv 2004.13336): reduce-scatter grads over the data axis, each dp
+    # shard owns 1/dp of the flattened params and their optimizer moments,
+    # all-gather the fresh windows.  "off" = replicated moments + pmean
+    # (the original path) | "on" = shard whenever data_parallel > 1 (a
+    # no-op at dp == 1 — warned in Config.__post_init__) | "auto" = on
+    # exactly when data_parallel > 1.  Bit-identical to the replicated
+    # path (tests/test_zero_sharding.py); applies to the SPMD train steps
+    # (parallel/spmd.py) — the single-device step has no data axis.
+    # NOT an EXECUTABLE_SPEC_FIELD: serving executables never touch
+    # opt_state, so the knob cannot change any lowered serving shape.
+    zero_sharding: str = "auto"
     adam_b1: float = 0.9
     adam_b2: float = 0.999
     adam_eps: float = 1e-8
     adagrad_init_accum: float = 1e-8  # ps:297 initial_accumulator_value
     momentum: float = 0.95            # ps:301
+
+    def __post_init__(self):
+        if self.zero_sharding not in ("off", "on", "auto"):
+            raise ValueError(
+                f"optimizer.zero_sharding must be 'off', 'on' or 'auto', "
+                f"got {self.zero_sharding!r}"
+            )
 
 
 @dataclass(frozen=True)
@@ -710,6 +729,18 @@ class Config:
                     f"frequent overflow fallback to the dense psum path "
                     f"(parallel/embedding.py)", stacklevel=2,
                 )
+        # 1b. zero_sharding='on' with a declared single-replica data axis
+        # is a silent no-op (there is nothing to shard the update across);
+        # warn so a flag meant for the pod doesn't quietly do nothing on a
+        # one-replica debug mesh.  dp == -1 (auto) is resolved at mesh
+        # build time and stays quiet here.
+        if o.zero_sharding == "on" and dp == 1:
+            warnings.warn(
+                "optimizer.zero_sharding='on' with mesh.data_parallel=1 "
+                "is a no-op: the weight update shards across the data "
+                "axis, and there is only one data shard "
+                "(train/optimizer.zero_sharded)", stacklevel=2,
+            )
         # 2. packed-sort id bound: the dedup paths (exchange plan, lazy
         # pack) sort (id, position) packed into ONE uint32 key; a vocab
         # too large for the local stream length falls back to the ~4x

@@ -217,14 +217,36 @@ def reshard_state(state, new_ctx):
     other leaf re-places with ``device_put``.  The elastic controller's
     resume path restores from the committed Orbax payload instead
     (exactly-once needs the durable snapshot); this is the in-memory fast
-    path for planned topology changes where no replay is required."""
+    path for planned topology changes where no replay is required.
+
+    Zero-sharded optimizer state (``train/optimizer.ZeroDpState``) moves
+    too: a dp change re-windows the flat moment leaves on-device exactly
+    like table rows (their layout is the canonical flatten), and a move
+    across the dp==1 boundary — where the sharded update switches on or
+    off and the opt_state STRUCTURE changes — relays through
+    ``checkpoint.reshard.relayout_state``."""
     import jax
 
     from ..checkpoint.reshard import (
+        _is_zero_leaf,
         _reshape_under_sharding_ok,
         jit_row_adapter,
+        relayout_state,
     )
+    from ..parallel.spmd import abstract_spmd_state
 
+    target_shapes = abstract_spmd_state(new_ctx)
+    if (jax.tree_util.tree_structure(state)
+            != jax.tree_util.tree_structure(target_shapes)):
+        # opt-state layout flips across the dp==1 boundary: leaves pair
+        # by flatten order and relayout through the canonical flat form
+        return relayout_state(
+            state, target_shapes, new_ctx.state_shardings
+        )
+    target_by_path = {
+        jax.tree_util.keystr(p): l
+        for p, l in jax.tree_util.tree_flatten_with_path(target_shapes)[0]
+    }
     pv_new = new_ctx.cfg.model.feature_size
 
     def _dim0_partitions(sharding) -> int:
@@ -238,12 +260,27 @@ def reshard_state(state, new_ctx):
         return p
 
     def adapt(path, leaf, sharding):
+        if _is_zero_leaf(path) and hasattr(leaf, "shape"):
+            # zero-layout opt-state leaf: TERMINAL branch.  The canonical
+            # flat form adapts through relayout_state's reform (handles
+            # dp re-windowing AND the rare eligibility flip where a leaf
+            # changes rank between topologies); an unchanged shape (the
+            # flat length is dp-independent) re-places as-is — it must
+            # NOT fall through to the table row-adapter, whose pv_new
+            # target would slice a (pv*dim,) flat moment down to (pv,)
+            tgt = target_by_path.get(jax.tree_util.keystr(path))
+            if tgt is not None and tuple(leaf.shape) != tuple(tgt.shape):
+                return jax.tree_util.tree_leaves(relayout_state(
+                    [leaf], [tgt], [sharding]
+                ))[0]
+            return jax.device_put(leaf, sharding)
         if (
             _is_table_path(path)
             and hasattr(leaf, "shape")
             and leaf.ndim >= 1
             and leaf.shape[0] != pv_new
         ):
+            rows_to = pv_new
             # the SAVED row count must divide the target's dim0 partitions
             # for the staged device_put (device_put requires divisibility);
             # odd paddings (e.g. 117,582 rows onto mp=4) take the
@@ -262,14 +299,14 @@ def reshard_state(state, new_ctx):
                 staged = jax.device_put(
                     leaf, NamedSharding(sharding.mesh, sharding.spec)
                 )
-                return jit_row_adapter(sharding, pv_new)(staged)
+                return jit_row_adapter(sharding, rows_to)(staged)
             import numpy as np
 
             host = np.asarray(jax.device_get(leaf))
-            if host.shape[0] >= pv_new:
-                host = host[:pv_new]
+            if host.shape[0] >= rows_to:
+                host = host[:rows_to]
             else:
-                pad = pv_new - host.shape[0]
+                pad = rows_to - host.shape[0]
                 host = np.concatenate(
                     [host, np.zeros((pad, *host.shape[1:]), host.dtype)]
                 )

@@ -35,7 +35,11 @@ from ..core.compat import shard_map
 from ..core.config import Config
 from ..models.base import get_model
 from ..ops.auc import AUCState, auc_init, auc_update
-from ..train.optimizer import build_optimizer
+from ..train.optimizer import (
+    build_optimizer,
+    resolve_zero_sharding,
+    zero_sharded,
+)
 from ..train.step import TrainState, sigmoid_cross_entropy
 from .embedding import (
     exchange_capacity,
@@ -61,6 +65,13 @@ class SPMDContext(NamedTuple):
     state_shardings: Any        # NamedSharding pytree matching TrainState
     batch_specs: Any
     batch_shardings: Any
+    # ZeRO-style dp-sharded weight update in effect (train/optimizer.
+    # zero_sharded): opt_state moment leaves live in the flattened
+    # dp-partitioned layout and the train steps reduce-scatter dense
+    # grads instead of pmean-ing them.  Normally resolve_zero_sharding
+    # of (cfg.optimizer, dp); make_context's ``zero_layout`` override
+    # exists for restore templates that must describe the OTHER layout.
+    zero_layout: bool = False
 
 
 def padded_vocab(
@@ -85,22 +96,67 @@ def _window_multiple(cfg: Config) -> int:
     return 1
 
 
-def _spec_for_leaf(path, shape: tuple[int, ...], vocab: int) -> P:
+def _spec_for_leaf(
+    path, shape: tuple[int, ...], vocab: int, dp: int = 1, mp: int = 1
+) -> P:
     """Row-shard exactly the leaves living under a TABLE_KEYS dict key whose
     leading dim is the (padded) vocab — this covers the params and their
     optimizer-state moments (optax states mirror the param tree, so the same
     dict keys appear in their paths).  Path-based matching cannot collide
-    with an MLP kernel that happens to share a dimension."""
+    with an MLP kernel that happens to share a dimension.
+
+    Leaves under a ``zero_dp`` marker (train/optimizer.ZeroDpState — the
+    dp-partitioned weight-update state) are the FLATTENED canonical
+    layout: dense moment leaves shard 1/dp over the data axis, table
+    moment leaves shard over (model, data) — each device owns the 1/dp
+    window of its model shard's rows.  An ineligible table leaf (see
+    ``zero_layout_size``) kept its original shape and falls through to
+    the standard row-shard rule; eligibility is a pure function of
+    (length, mp, dp), so the 1-D fm_w ambiguity resolves itself: the
+    flat layout EXISTS exactly when the divisibility test passes."""
     keys = {getattr(p, "key", None) for p in path}
+    if any(getattr(p, "name", None) == "zero_dp" for p in path):
+        if keys & set(TABLE_KEYS):
+            if (len(shape) == 1 and shape[0] > 0 and shape[0] % mp == 0
+                    and (shape[0] // mp) % dp == 0):
+                return P((MODEL_AXIS, DATA_AXIS))
+            # ineligible leaf at its original shape: standard rule below
+        elif len(shape) == 1:
+            return P(DATA_AXIS)
+        elif len(shape) == 0:
+            return P()
     if keys & set(TABLE_KEYS) and len(shape) >= 1 and shape[0] == vocab:
         return P(MODEL_AXIS, *([None] * (len(shape) - 1)))
     return P()
 
 
-def _build_full_init(cfg: Config, true_vocab: int) -> Callable:
+def _build_tx(cfg: Config, zero_layout: bool):
+    """The SPMD step's gradient transformation: the configured optax chain,
+    wrapped with the ZeRO dp-partitioned weight update when the zero
+    layout is in effect (train/optimizer.zero_sharded — reduce-scatter of
+    dense grads, 1/dp-windowed moments, all-gather of fresh windows)."""
+    tx = build_optimizer(
+        cfg.optimizer, data_parallel_size=cfg.mesh.data_parallel
+    )
+    if zero_layout:
+        tx = zero_sharded(
+            tx,
+            dp=cfg.mesh.data_parallel,
+            mp=cfg.mesh.model_parallel,
+            vocab=cfg.model.feature_size,
+            data_axis=DATA_AXIS,
+            model_axis=MODEL_AXIS,
+            table_keys=TABLE_KEYS,
+        )
+    return tx
+
+
+def _build_full_init(
+    cfg: Config, true_vocab: int, zero_layout: bool = False
+) -> Callable:
     """Initializer for the full TrainState with zeroed pad rows."""
     model = get_model(cfg.model)
-    tx = build_optimizer(cfg.optimizer, data_parallel_size=cfg.mesh.data_parallel)
+    tx = _build_tx(cfg, zero_layout)
 
     def init_fn(key: jax.Array) -> TrainState:
         from ..train.step import init_opt_state
@@ -124,9 +180,16 @@ def _build_full_init(cfg: Config, true_vocab: int) -> Callable:
     return init_fn
 
 
-def make_context(cfg: Config, mesh: Mesh) -> SPMDContext:
+def make_context(
+    cfg: Config, mesh: Mesh, *, zero_layout: bool | None = None
+) -> SPMDContext:
     """Compute sharding specs for the TrainState via shape inference only —
-    no parameter materialization (the 100M-vocab table never touches a host)."""
+    no parameter materialization (the 100M-vocab table never touches a host).
+
+    ``zero_layout`` overrides the ``optimizer.zero_sharding`` resolution
+    (None = resolve from config) — used by the cross-topology restore to
+    build a template describing the OTHER opt-state layout
+    (checkpoint/reshard.py); training contexts leave it None."""
     dp, mp = mesh_shape(mesh)
     true_vocab = cfg.model.feature_size
     pv = padded_vocab(true_vocab, mp, _window_multiple(cfg))
@@ -134,10 +197,12 @@ def make_context(cfg: Config, mesh: Mesh) -> SPMDContext:
         model={"feature_size": pv},
         mesh={"data_parallel": dp, "model_parallel": mp},
     )
-    init_fn = _build_full_init(cfg, true_vocab)
+    if zero_layout is None:
+        zero_layout = resolve_zero_sharding(cfg.optimizer, dp)
+    init_fn = _build_full_init(cfg, true_vocab, zero_layout)
     shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
     state_specs = jax.tree_util.tree_map_with_path(
-        lambda p, s: _spec_for_leaf(p, s.shape, pv), shapes
+        lambda p, s: _spec_for_leaf(p, s.shape, pv, dp, mp), shapes
     )
     state_shardings = jax.tree_util.tree_map(
         lambda spec: NamedSharding(mesh, spec), state_specs
@@ -156,7 +221,7 @@ def make_context(cfg: Config, mesh: Mesh) -> SPMDContext:
     batch_shardings["weight"] = NamedSharding(mesh, P(DATA_AXIS))
     return SPMDContext(
         cfg, true_vocab, mesh, state_specs, state_shardings, batch_specs,
-        batch_shardings,
+        batch_shardings, zero_layout,
     )
 
 
@@ -164,7 +229,8 @@ def abstract_spmd_state(ctx: SPMDContext) -> TrainState:
     """ShapeDtypeStruct pytree of the TrainState — for lowering-only
     consumers (the trace-time collective audit) that must never
     materialize the tables."""
-    init_fn = _build_full_init(ctx.cfg, ctx.true_feature_size)
+    init_fn = _build_full_init(ctx.cfg, ctx.true_feature_size,
+                               ctx.zero_layout)
     return jax.eval_shape(init_fn, jax.random.PRNGKey(0))
 
 
@@ -173,7 +239,8 @@ def create_spmd_state(ctx: SPMDContext, key: jax.Array | None = None) -> TrainSt
     each table shard on its own device (deterministic across replicas — the
     BroadcastGlobalVariablesHook capability, hvd:417-418, by construction)."""
     key = jax.random.PRNGKey(ctx.cfg.run.seed) if key is None else key
-    init_fn = _build_full_init(ctx.cfg, ctx.true_feature_size)
+    init_fn = _build_full_init(ctx.cfg, ctx.true_feature_size,
+                               ctx.zero_layout)
     with ctx.mesh:
         return jax.jit(init_fn, out_shardings=ctx.state_shardings)(key)
 
@@ -256,7 +323,7 @@ def _build_local_train_step(ctx: SPMDContext) -> Callable:
     (``make_spmd_train_loop``).  Metrics follow ``_TRAIN_METRIC_SPECS``."""
     cfg = ctx.cfg
     model = get_model(cfg.model)
-    tx = build_optimizer(cfg.optimizer, data_parallel_size=cfg.mesh.data_parallel)
+    tx = _build_tx(cfg, ctx.zero_layout)
     if cfg.optimizer.lazy_embedding_updates:
         return _build_lazy_local_step(ctx, model, tx)
 
@@ -274,9 +341,20 @@ def _build_local_train_step(ctx: SPMDContext) -> Callable:
             loss_fn, has_aux=True
         )(state.params)
         new_model_state = _sync_model_state(new_model_state)
-        grads = _pmean_grads(grads)
-        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        if ctx.zero_layout:
+            # RAW local grads go in — the wrapper reduce-scatters each
+            # leaf over the data axis itself (a pmean here would add the
+            # exact all-reduce the sharded update exists to remove),
+            # updates its 1/dp window, and all-gathers the fresh params
+            new_params, new_opt_state = tx.update_and_apply(
+                grads, state.opt_state, state.params
+            )
+        else:
+            grads = _pmean_grads(grads)
+            updates, new_opt_state = tx.update(
+                grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates)
         metrics = {
             "loss": lax.pmean(loss, DATA_AXIS),
             "ce": lax.pmean(ce, DATA_AXIS),
@@ -458,10 +536,16 @@ def _build_lazy_local_step(ctx: SPMDContext, model, tx) -> Callable:
             loss_fn, argnums=(0, 1), has_aux=True
         )(rest, rows)
         new_model_state = _sync_model_state(new_model_state)
-        g_rest = _pmean_grads(g_rest)
         rest_opt, lazy_state = state.opt_state
-        updates, new_rest_opt = tx.update(g_rest, rest_opt, rest)
-        new_rest = optax.apply_updates(rest, updates)
+        if ctx.zero_layout:
+            # zero layout reduce-scatters inside the wrapper instead
+            new_rest, new_rest_opt = tx.update_and_apply(
+                g_rest, rest_opt, rest
+            )
+        else:
+            g_rest = _pmean_grads(g_rest)
+            updates, new_rest_opt = tx.update(g_rest, rest_opt, rest)
+            new_rest = optax.apply_updates(rest, updates)
 
         # global id stream over the data axis (replicated over the model
         # axis).  Global loss = mean of shard means -> 1/dp scale.

@@ -62,6 +62,12 @@ fi
 # under transfer_guard('disallow') with the table as a lowered parameter
 # (no host round-trip on table leaves) and the redistribution plan must
 # stay minimal-traffic (a same-width shrink plans ZERO table bytes).
+# — and the ZERO-UPDATE contract (audit_zero_update): with the ZeRO
+# dp-sharded weight update active the lowered SPMD step must carry one
+# data-axis reduce-scatter per sharded param leaf (never a grad-sized
+# data-axis all-reduce), all-gather the fresh 1/dp param windows, lower
+# every flattened moment leaf with 1/dp-sized per-shard shapes, and stay
+# transfer-guard-clean with the state donated.
 # — and the OBSERVABILITY contract (audit_observability): the unified obs
 # layer (deepfm_tpu/obs) must never enter lowered code — the serving
 # predict and train step lower under transfer_guard('disallow') with no

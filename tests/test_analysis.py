@@ -1014,6 +1014,100 @@ class TestCollectiveContract:
         assert by_op["all_reduce"]["shapes"] == [(16, 8)]
 
 
+class TestZeroUpdateContract:
+    """Engine-2 zero-update contract (trace_audit.audit_zero_update): the
+    dp-sharded weight update must lower with reduce-scatter (never a
+    grad-sized data-axis all-reduce) on dense grads and dp-sharded
+    (1/dp per-shard) moment leaves — and each seeded violation (a
+    replicated-path lowering fed through the contract; replicated
+    moments behind the flag) is caught."""
+
+    def _replicated_lowering(self):
+        import jax
+        import jax.numpy as jnp
+
+        from deepfm_tpu.analysis.trace_audit import _audit_cfg
+        from deepfm_tpu.core.config import MeshConfig
+        from deepfm_tpu.parallel import (
+            abstract_spmd_state, build_mesh, make_context,
+            make_spmd_train_step,
+        )
+
+        base = _audit_cfg().with_overrides(
+            data={"batch_size": 128},
+            optimizer={"zero_sharding": "off"},
+        )
+        mesh = build_mesh(MeshConfig(data_parallel=2, model_parallel=4))
+        ctx = make_context(base, mesh)
+        state = abstract_spmd_state(ctx)
+        b, f = 128, base.model.field_size
+        batch = {
+            "feat_ids": jax.ShapeDtypeStruct((b, f), jnp.int32),
+            "feat_vals": jax.ShapeDtypeStruct((b, f), jnp.float32),
+            "label": jax.ShapeDtypeStruct((b,), jnp.float32),
+        }
+        step = make_spmd_train_step(ctx, donate=False)
+        return ctx, state, step.lower(state, batch).as_text()
+
+    def test_real_zero_step_holds_the_contract(self):
+        from deepfm_tpu.analysis.trace_audit import audit_zero_update
+
+        findings = audit_zero_update()
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_seeded_allreduce_lowering_caught(self):
+        """A replicated-path (zero=off) lowering fed through the zero
+        contract — the shape the regression takes if the spmd wiring
+        silently falls back to pmean + full-width update — must be
+        flagged on all three axes: the surviving data-axis all-reduce,
+        the missing per-leaf reduce-scatter, the missing window gather."""
+        from deepfm_tpu.analysis.trace_audit import check_zero_collectives
+
+        _, _, text = self._replicated_lowering()
+        viol = check_zero_collectives(
+            text, dp=2, mp=4, n_sharded_leaves=11
+        )
+        slugs = {v.source for v in viol}
+        assert "zero-dense-allreduce" in slugs
+        assert "zero-reduce-scatter-missing" in slugs
+        assert "zero-allgather-missing" in slugs
+        assert all(v.rule == "trace-collective" for v in viol)
+
+    def test_seeded_replicated_moments_caught(self):
+        """Replicated moments behind the flag: (a) a plain opt_state with
+        no zero_dp layout at all; (b) a zero-layout tree whose flat
+        moment leaves carry replicated shardings — both flagged."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from deepfm_tpu.analysis.trace_audit import (
+            check_zero_state_sharding,
+        )
+        from deepfm_tpu.parallel import abstract_spmd_state
+
+        ctx, state, _ = self._replicated_lowering()
+        viol = check_zero_state_sharding(
+            ctx.state_shardings.opt_state, state.opt_state, dp=2
+        )
+        assert [v.source for v in viol] == ["zero-moments-unsharded"]
+        # (b): the sharded layout with its data axis stripped — every
+        # flat moment leaf claims full-size per-shard residency
+        from deepfm_tpu.core.config import MeshConfig
+        from deepfm_tpu.parallel import build_mesh, make_context
+
+        base = ctx.cfg.with_overrides(optimizer={"zero_sharding": "on"})
+        mesh = build_mesh(MeshConfig(data_parallel=2, model_parallel=4))
+        zctx = make_context(base, mesh)
+        zstate = abstract_spmd_state(zctx)
+        stripped = jax.tree_util.tree_map(
+            lambda sh: NamedSharding(mesh, P()), zctx.state_shardings
+        )
+        viol = check_zero_state_sharding(
+            stripped.opt_state, zstate.opt_state, dp=2
+        )
+        assert [v.source for v in viol] == ["zero-moments-replicated"]
+
+
 class TestSeededViolationsEndToEnd:
     """The acceptance trio: a tracer .item() inside jit, an unguarded
     mutation of a locked attribute, and an off-bucket request shape are

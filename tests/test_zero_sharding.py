@@ -1,0 +1,464 @@
+"""ZeRO-style dp-sharded weight update (optimizer.zero_sharding).
+
+The contract (train/optimizer.zero_sharded + parallel/spmd.py): the
+sharded update — reduce-scatter grads over the data axis, update the
+owned 1/dp window of params+moments, all-gather the fresh windows — is
+BIT-IDENTICAL to the replicated pmean + full-width update on the product
+meshes ([2,4]/[4,2]), for the dense, lazy and scanned-loop step variants.
+The moments live flattened and dp-partitioned (1/dp per shard), and the
+cross-topology restore adapts the layout in every direction: dp→dp',
+dp-sharded→replicated (the dp'=1 publisher-process path), and a legacy
+replicated payload upgrading into the sharded layout — all bit-exact
+against the uninterrupted-replay oracle (which exists BECAUSE the two
+layouts are bit-identical step-for-step).
+"""
+
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from deepfm_tpu.core.config import Config, MeshConfig, OptimizerConfig
+from deepfm_tpu.parallel import (
+    build_mesh,
+    create_spmd_state,
+    make_context,
+    make_spmd_train_loop,
+    make_spmd_train_step,
+    shard_batch,
+    shard_batch_stacked,
+)
+
+FEATURE = 117
+
+CFG = Config.from_dict(
+    {
+        "model": {
+            "feature_size": FEATURE,
+            "field_size": 6,
+            "embedding_size": 4,
+            # fm_b is shape (1,): every dp > 1 exercises the flatten/
+            # partition helper's trailing-pad window on a real leaf
+            "deep_layers": (16,),
+            "dropout_keep": (0.5,),
+            "l2_reg": 0.001,
+            "compute_dtype": "float32",
+        },
+        "optimizer": {"learning_rate": 0.01},
+    }
+)
+
+
+def _batch(i, b=32, cfg=CFG):
+    r = np.random.default_rng(100 + i)
+    f = cfg.model.field_size
+    v = cfg.model.feature_size
+    return {
+        "feat_ids": r.integers(0, v, size=(b, f)),
+        "feat_vals": r.random((b, f), dtype=np.float32),
+        "label": (r.random(b) < 0.3).astype(np.float32),
+    }
+
+
+def _mesh(dp, mp, devices=None):
+    return build_mesh(
+        MeshConfig(data_parallel=dp, model_parallel=mp), devices=devices
+    )
+
+
+def _run(cfg, mesh, steps, *, scan=0):
+    ctx = make_context(cfg, mesh)
+    state = create_spmd_state(ctx)
+    losses = []
+    if scan:
+        loop = make_spmd_train_loop(ctx, scan, donate=False)
+        for i in range(0, steps, scan):
+            sb = shard_batch_stacked(
+                ctx, [_batch(i + j) for j in range(scan)]
+            )
+            state, ms = loop(state, sb)
+            losses.extend(np.asarray(ms["loss"]).tolist())
+    else:
+        step = make_spmd_train_step(ctx, donate=False)
+        for i in range(steps):
+            state, m = step(state, shard_batch(ctx, _batch(i)))
+            losses.append(float(m["loss"]))
+    return ctx, state, losses
+
+
+# shard_map compiles dominate this module's wall clock; the parity and
+# restore tests reuse identical (config, mesh, steps) runs, so memoize
+# them (states are never mutated — donate=False, restores only read)
+_RUNS: dict = {}
+
+
+def _run_cached(mode, dp, mp, steps, *, lazy=False, scan=0, opt="Adam"):
+    key = (mode, dp, mp, steps, lazy, scan, opt)
+    if key not in _RUNS:
+        cfg = CFG.with_overrides(optimizer={
+            "zero_sharding": mode,
+            "lazy_embedding_updates": lazy,
+            "name": opt,
+        })
+        _RUNS[key] = _run(cfg, _mesh(dp, mp), steps, scan=scan)
+    return _RUNS[key]
+
+
+def _assert_tree_bitwise(a, b, what=""):
+    for (path, x), (_, y) in zip(
+        jax.tree_util.tree_flatten_with_path(a)[0],
+        jax.tree_util.tree_flatten_with_path(b)[0],
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(x)), np.asarray(jax.device_get(y)),
+            err_msg=f"{what}{jax.tree_util.keystr(path)}",
+        )
+
+
+@pytest.mark.parametrize("dp,mp", [(2, 4), (4, 2)])
+def test_dense_bit_parity_with_replicated(dp, mp):
+    """The headline contract: zero_sharding=on is bit-identical to the
+    replicated path — loss trajectory AND final params."""
+    _, st_off, l_off = _run_cached("off", dp, mp, 4)
+    _, st_on, l_on = _run_cached("on", dp, mp, 4)
+    assert l_off == l_on
+    _assert_tree_bitwise(st_off.params, st_on.params, f"[{dp},{mp}] ")
+
+
+@pytest.mark.parametrize("dp,mp", [(2, 4), (4, 2)])
+def test_lazy_bit_parity_with_replicated(dp, mp):
+    """The lazy variant's `rest` (non-table) update shards identically;
+    the lazy tables keep their touched-rows update untouched."""
+    _, st_off, l_off = _run_cached("off", dp, mp, 4, lazy=True)
+    _, st_on, l_on = _run_cached("on", dp, mp, 4, lazy=True)
+    assert l_off == l_on
+    _assert_tree_bitwise(st_off.params, st_on.params, f"lazy[{dp},{mp}] ")
+
+
+def test_scan_loop_bit_parity_with_replicated():
+    """The fused K-step scan loop shares the same local step body."""
+    _, st_off, l_off = _run_cached("off", 2, 4, 4, scan=2)
+    _, st_on, l_on = _run_cached("on", 2, 4, 4, scan=2)
+    assert l_off == l_on
+    _assert_tree_bitwise(st_off.params, st_on.params, "scan ")
+
+
+def test_moments_are_dp_partitioned():
+    """The state-residency claim: every eligible moment leaf lives
+    flattened with a 1/dp-sized per-shard window (tables additionally
+    1/mp), under the zero_dp layout marker."""
+    ctx, state, _ = _run_cached("on", 2, 4, 4)
+    leaves = jax.tree_util.tree_flatten_with_path(state.opt_state)[0]
+    marked = [
+        (p, l) for p, l in leaves
+        if any(getattr(k, "name", None) == "zero_dp" for k in p)
+    ]
+    assert marked, "opt_state lost the ZeroDpState layout marker"
+    pv = ctx.cfg.model.feature_size
+    k = ctx.cfg.model.embedding_size
+    seen_flat = 0
+    for path, leaf in marked:
+        if not getattr(leaf, "shape", ()):
+            continue  # optimizer step counts
+        assert leaf.ndim == 1, (
+            f"{jax.tree_util.keystr(path)} not flattened: {leaf.shape}"
+        )
+        seen_flat += 1
+        keystr = jax.tree_util.keystr(path)
+        shard0 = leaf.addressable_shards[0].data.shape[0]
+        if "fm_v" in keystr:
+            assert leaf.shape == (pv * k,)
+            assert shard0 == pv * k // (4 * 2)  # 1/(mp*dp)
+        elif "fm_w" in keystr:
+            assert leaf.shape == (pv,)
+            assert shard0 == pv // (4 * 2)
+        else:
+            assert shard0 * 2 <= leaf.shape[0] or leaf.shape[0] < 2, (
+                f"{keystr}: per-shard {shard0} of {leaf.shape[0]} is not "
+                f"dp-sharded"
+            )
+    assert seen_flat >= 4
+
+
+def test_ineligible_table_leaf_keeps_replicated_update():
+    """A table leaf whose per-model-shard size does not divide dp keeps
+    its original-shape moments and the pmean update — and the step stays
+    bit-identical to the replicated path."""
+    cfg = CFG.with_overrides(
+        model={"feature_size": 10, "embedding_size": 3}
+    )
+    mesh = _mesh(4, 2)  # fm_v local 5*3=15, fm_w local 5: 15 % 4 != 0
+    ctx = make_context(
+        cfg.with_overrides(optimizer={"zero_sharding": "on"}), mesh
+    )
+    state = create_spmd_state(ctx)
+    pv = ctx.cfg.model.feature_size
+    leaves = {
+        jax.tree_util.keystr(p): l
+        for p, l in jax.tree_util.tree_flatten_with_path(state.opt_state)[0]
+    }
+    mu_fmv = next(v for k, v in leaves.items()
+                  if "mu" in k and "fm_v" in k)
+    assert mu_fmv.shape == (pv, 3)  # original shape — ineligible fallback
+
+    def run_small(mode):
+        c = cfg.with_overrides(optimizer={"zero_sharding": mode})
+        ctx = make_context(c, mesh)
+        st = create_spmd_state(ctx)
+        step = make_spmd_train_step(ctx, donate=False)
+        losses = []
+        for i in range(4):
+            st, m = step(st, shard_batch(ctx, _batch(i, cfg=c)))
+            losses.append(float(m["loss"]))
+        return st, losses
+
+    st_off, l_off = run_small("off")
+    st_on, l_on = run_small("on")
+    assert l_off == l_on
+    _assert_tree_bitwise(st_off.params, st_on.params, "ineligible ")
+
+
+def test_adagrad_bit_parity_and_zero_padding_tail(tmp_path):
+    """A non-Adam chain with a NONZERO accumulator floor shards
+    identically — and the floor must not leak into the padding tail (the
+    canonical layout's restore guard verifies the dropped tail is
+    zero), so the sharded payload downgrades onto dp'=1 cleanly."""
+    _, st_off, l_off = _run_cached("off", 2, 4, 3, opt="Adagrad")
+    _, st_on, l_on = _run_cached("on", 2, 4, 3, opt="Adagrad")
+    assert l_off == l_on
+    _assert_tree_bitwise(st_off.params, st_on.params, "Adagrad ")
+    from deepfm_tpu.checkpoint import Checkpointer, restore_resharded
+
+    ck = Checkpointer(tmp_path / "ck")
+    ck.save(st_on, block=True)
+    devs = jax.devices()
+    ctx_1 = make_context(
+        CFG.with_overrides(optimizer={"name": "Adagrad",
+                                      "zero_sharding": "on"}),
+        _mesh(1, 4, devices=devs[:4]),
+    )
+    st_1 = restore_resharded(ck, ctx_1)
+    for x, y in zip(jax.tree_util.tree_leaves(st_off.opt_state),
+                    jax.tree_util.tree_leaves(st_1.opt_state)):
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(x)), np.asarray(jax.device_get(y))
+        )
+    ck.close()
+
+
+# ---------------------------------------------------------------------------
+# restore matrix
+
+
+def _save(tmp_path, state, name="ck"):
+    from deepfm_tpu.checkpoint import Checkpointer
+
+    ck = Checkpointer(tmp_path / name)
+    ck.save(state, block=True)
+    return ck
+
+
+def test_restore_across_dp_change_bit_exact_vs_oracle(tmp_path):
+    """dp-sharded payload saved at [2,4], restored at [4,2], trained on —
+    bit-exact against the replicated-path oracle doing the SAME topology
+    change (the long-proven restore path, valid as an oracle because the
+    two layouts are bit-identical step-for-step)."""
+    from deepfm_tpu.checkpoint import restore_resharded
+
+    cfg_on = CFG.with_overrides(optimizer={"zero_sharding": "on"})
+    cfg_off = CFG.with_overrides(optimizer={"zero_sharding": "off"})
+    _, st_on, _ = _run_cached("on", 2, 4, 4)
+    _, st_off, _ = _run_cached("off", 2, 4, 4)
+    ck = _save(tmp_path, st_on)
+    ck2 = _save(tmp_path, st_off, "ck_off")
+    mesh_b = _mesh(4, 2)
+    ctx_b = make_context(cfg_on, mesh_b)
+    st_b = restore_resharded(ck, ctx_b)
+    step_b = make_spmd_train_step(ctx_b, donate=False)
+    for i in range(4, 6):
+        st_b, _ = step_b(st_b, shard_batch(ctx_b, _batch(i)))
+    ctx_b2 = make_context(cfg_off, mesh_b)
+    st_b2 = restore_resharded(ck2, ctx_b2)
+    step_b2 = make_spmd_train_step(ctx_b2, donate=False)
+    for i in range(4, 6):
+        st_b2, _ = step_b2(st_b2, shard_batch(ctx_b2, _batch(i)))
+    _assert_tree_bitwise(st_b2.params, st_b.params, "dp-change ")
+    ck.close()
+    ck2.close()
+
+
+def test_legacy_replicated_payload_upgrades_into_sharded_layout(tmp_path):
+    """A payload committed by the replicated path (zero off — the legacy
+    moment layout) restores into the dp-sharded layout and continues
+    bit-exactly vs the uninterrupted zero-on replay."""
+    from deepfm_tpu.checkpoint import restore_resharded
+
+    ctx_on, st_on, _ = _run_cached("on", 2, 4, 4)
+    _, st_legacy, _ = _run_cached("off", 2, 4, 4)
+    ck = _save(tmp_path, st_legacy)
+    restored = restore_resharded(ck, ctx_on)
+    # structure upgraded to the sharded layout
+    assert any(
+        getattr(k, "name", None) == "zero_dp"
+        for p, _ in jax.tree_util.tree_flatten_with_path(
+            restored.opt_state)[0]
+        for k in p
+    )
+    # the uninterrupted oracle and the upgraded lineage continue through
+    # ONE compiled step — bit-equality is about the restored VALUES
+    step = make_spmd_train_step(ctx_on, donate=False)
+    st, oracle = restored, st_on
+    for i in range(4, 6):
+        st, _ = step(st, shard_batch(ctx_on, _batch(i)))
+        oracle, _ = step(oracle, shard_batch(ctx_on, _batch(i)))
+    _assert_tree_bitwise(oracle.params, st.params, "legacy-upgrade ")
+    ck.close()
+
+
+def test_sharded_payload_restores_onto_dp1_replicated(tmp_path):
+    """The publisher-process path (PR 12): a dp-sharded payload restored
+    onto dp'=1 — where the sharded update is inactive and the layout is
+    plain — downgrades bit-exactly (params AND unflattened moments)."""
+    from deepfm_tpu.checkpoint import restore_resharded
+
+    cfg_on = CFG.with_overrides(optimizer={"zero_sharding": "on"})
+    _, st_a, _ = _run_cached("on", 2, 4, 4)
+    _, st_off, _ = _run_cached("off", 2, 4, 4)
+    ck = _save(tmp_path, st_a)
+    devs = jax.devices()
+    mesh_1 = _mesh(1, 4, devices=devs[:4])
+    ctx_1 = make_context(cfg_on, mesh_1)
+    assert not ctx_1.zero_layout  # dp == 1: sharded update inactive
+    st_1 = restore_resharded(ck, ctx_1)
+    assert not any(
+        getattr(k, "name", None) == "zero_dp"
+        for p, _ in jax.tree_util.tree_flatten_with_path(st_1.opt_state)[0]
+        for k in p
+    )
+    _assert_tree_bitwise(st_a.params, st_1.params, "dp1-params ")
+    # moments: the flat windows reassemble into the plain shapes with the
+    # exact same content (compare via the replicated twin of the run,
+    # which is bit-identical by the parity contract)
+    off_leaves = jax.tree_util.tree_leaves(st_off.opt_state)
+    one_leaves = jax.tree_util.tree_leaves(st_1.opt_state)
+    assert len(off_leaves) == len(one_leaves)
+    for x, y in zip(off_leaves, one_leaves):
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(x)), np.asarray(jax.device_get(y))
+        )
+    ck.close()
+
+
+def test_payload_roundtrip_with_cursor_across_dp(tmp_path):
+    """The elastic commit path: an OnlinePayload with a zero-layout train
+    state reshards across the dp==1 boundary and back, cursor intact and
+    state byte-identical (the [2,4]→[1,4]→[2,4] chaos-drill shape)."""
+    from deepfm_tpu.checkpoint import (
+        Checkpointer,
+        restore_resharded_payload,
+    )
+    from deepfm_tpu.online.stream import StreamCursor
+    from deepfm_tpu.online.trainer import OnlinePayload
+
+    cfg = CFG.with_overrides(optimizer={"zero_sharding": "on"})
+    devs = jax.devices()
+    _, st_a, _ = _run_cached("on", 2, 4, 4)
+    cursor = StreamCursor(segment="000000000007.tfrecords", record=3)
+    ck = Checkpointer(tmp_path / "ck")
+    ck.save(OnlinePayload.wrap(st_a, cursor), block=True)
+    # shrink onto [1,4]: layout flips to replicated
+    ctx_1 = make_context(cfg, _mesh(1, 4, devices=devs[:4]))
+    p1 = restore_resharded_payload(ck, ctx_1)
+    assert p1.cursor() == cursor
+    ck1 = Checkpointer(tmp_path / "ck1")
+    ck1.save(OnlinePayload.wrap(p1.train, cursor), block=True)
+    # grow back onto [2,4]: layout flips back to dp-sharded
+    ctx_b = make_context(cfg, _mesh(2, 4))
+    p2 = restore_resharded_payload(ck1, ctx_b)
+    assert p2.cursor() == cursor
+    _assert_tree_bitwise(st_a.params, p2.train.params, "roundtrip-params ")
+    _assert_tree_bitwise(
+        st_a.opt_state, p2.train.opt_state, "roundtrip-moments "
+    )
+    ck.close()
+    ck1.close()
+
+
+def test_live_reshard_state_moves_zero_moments(tmp_path):
+    """elastic.plan.reshard_state (the in-memory fast path) re-windows
+    flat moment leaves across a width change without a host bounce and
+    relays the layout across the dp==1 boundary."""
+    from deepfm_tpu.elastic import reshard_state
+
+    cfg = CFG.with_overrides(optimizer={"zero_sharding": "on"})
+    _, st_a, _ = _run_cached("on", 2, 4, 4)
+    # width change, dp stays: flat table moments re-cut ([2,4] -> [4,2])
+    ctx_b = make_context(cfg, _mesh(4, 2))
+    moved = reshard_state(st_a, ctx_b)
+    for k in ("fm_w", "fm_v"):
+        a = np.asarray(jax.device_get(st_a.params[k]))[:FEATURE]
+        b = np.asarray(jax.device_get(moved.params[k]))[:FEATURE]
+        np.testing.assert_array_equal(a, b)
+    # same-topology move (host replacement / dp-only change keeps the
+    # flat moment lengths): the zero-leaf branch must be TERMINAL — a
+    # fall-through into the table row-adapter would slice a (pv*dim,)
+    # flat moment down to (pv,) rows (regression: caught in review)
+    ctx_same = make_context(cfg, _mesh(2, 4))
+    same = reshard_state(st_a, ctx_same)
+    _assert_tree_bitwise(
+        st_a.opt_state, same.opt_state, "live-same-topo-moments "
+    )
+    # across the dp==1 boundary: structure relayout
+    devs = jax.devices()
+    ctx_1 = make_context(cfg, _mesh(1, 4, devices=devs[:4]))
+    flat = reshard_state(st_a, ctx_1)
+    assert not any(
+        getattr(k, "name", None) == "zero_dp"
+        for p, _ in jax.tree_util.tree_flatten_with_path(flat.opt_state)[0]
+        for k in p
+    )
+    _assert_tree_bitwise(st_a.params, flat.params, "live-dp1 ")
+
+
+def test_publisher_artifacts_are_layout_invariant(tmp_path):
+    """Moments never ship: the published params are identical whatever
+    the opt-state layout (the mpmd host-side publish path drops
+    opt_state; param_tree_hash must agree across layouts)."""
+    from deepfm_tpu.online.publisher import param_tree_hash
+
+    _, st_off, _ = _run_cached("off", 2, 4, 4)
+    _, st_on, _ = _run_cached("on", 2, 4, 4)
+    assert param_tree_hash(st_on.params, st_on.model_state) == \
+        param_tree_hash(st_off.params, st_off.model_state)
+
+
+# ---------------------------------------------------------------------------
+# config knob
+
+
+def test_zero_sharding_unknown_value_raises():
+    with pytest.raises(ValueError, match="zero_sharding"):
+        OptimizerConfig(zero_sharding="sometimes")
+
+
+def test_zero_sharding_on_with_dp1_warns():
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        Config.from_dict({
+            "optimizer": {"zero_sharding": "on"},
+            "mesh": {"data_parallel": 1},
+        })
+    assert any("no-op" in str(x.message) for x in w)
+
+
+def test_zero_sharding_auto_resolution():
+    from deepfm_tpu.train.optimizer import resolve_zero_sharding
+
+    assert resolve_zero_sharding(OptimizerConfig(), 2)          # auto, dp>1
+    assert not resolve_zero_sharding(OptimizerConfig(), 1)      # auto, dp=1
+    off = OptimizerConfig(zero_sharding="off")
+    assert not resolve_zero_sharding(off, 8)
+    on = OptimizerConfig(zero_sharding="on")
+    assert resolve_zero_sharding(on, 2)
+    assert not resolve_zero_sharding(on, 1)  # structural no-op at dp=1
