@@ -512,6 +512,22 @@ def main() -> None:
 
         r = multitenant.main()
         sys.exit(0 if r["ok"] else 1)
+    if len(sys.argv) > 1 and sys.argv[1] == "--slo":
+        # the SLO control-plane gate (benchmarks/slo_control.py): one
+        # diurnal + 10x-spike trace against a static 2-group pool vs the
+        # adaptive pool (deadline-aware admission + shed ladder, hedged
+        # tails under a 5% budget, AutoScaler-driven 1→4 group scaling
+        # through the router's add/remove_group path); emits
+        # docs/BENCH_SLO.json and FAILS (exit 1) unless adaptive beats
+        # static on SLO attainment with hedges inside budget, zero
+        # admitted-then-failed requests, and the pool converged back to
+        # min_groups after the spike.  Host-only by design — the control
+        # plane is host-side policy (audit_control_plane pins it out of
+        # the lowered predict), so chips are irrelevant here.
+        import slo_control
+
+        r = slo_control.main()
+        sys.exit(0 if r["ok"] else 1)
     if len(sys.argv) > 1 and sys.argv[1] == "--elastic":
         # the elastic chaos drill (benchmarks/elastic_drill.py): shrink
         # [2,4]→[1,4] and grow back mid-run under serving load; emits

@@ -1722,6 +1722,155 @@ def audit_observability(cfg=None, predict_builder=None,
 
 
 # ---------------------------------------------------------------------------
+# SLO control-plane contract (adaptive serving, deepfm_tpu/serve/control)
+
+
+def audit_control_plane(cfg=None, predict_builder=None) -> list[Finding]:
+    """The adaptive-serving contract: every SLO decision — cost-model
+    admission, the shed ladder, hedging, autoscaling — is host-side
+    policy (serve/control/), and NONE of it may enter the lowered
+    serving graph.  The audit builds the full control plane, feeds it a
+    realistic observation stream (dispatch timings, queue-depth samples,
+    sustained-breach autoscale signals — what the live pool feeds it),
+    then holds the REAL serving predict to the lowering contract with
+    the control plane alive:
+
+    * lowers under ``jax.transfer_guard("disallow")`` — an admission
+      decision that closed over a traced value concretizes it here;
+    * no host callbacks in the lowered module — a scale/hedge decision
+      smuggled into the graph via ``io_callback`` lowers as a
+      ``custom_call`` the scanner catches;
+    * two successive lowerings identical — a control-plane reading
+      (utilization EWMA, token count, cost estimate) baked into the
+      trace changes per retrace.
+
+    ``predict_builder(model, cfg)`` lets the seeded-violation tests
+    (tests/test_analysis.py) feed both failure shapes through the same
+    checks."""
+    import jax
+
+    out: list[Finding] = []
+    cfg = cfg or _audit_cfg()
+    where = "deepfm_tpu/serve/control"
+    # the control plane itself is plain host code: construct it whole
+    # and feed it — if any of this needed a device or a trace, the
+    # policy layer would be broken by design
+    from ..serve.control.admission import (
+        AdmissionController,
+        DeadlineRejectedError,
+        LoadShedGate,
+    )
+    from ..serve.control.autoscale import AutoScaler
+    from ..serve.control.cost import BucketCostModel
+    from ..serve.control.hedge import HedgeController, TokenBudget
+
+    buckets = _default_buckets()
+    try:
+        cost = BucketCostModel(buckets)
+        for bkt in buckets:
+            cost.observe(bkt, 1e-3 * bkt)
+        adm = AdmissionController(
+            cost, deadline_ms=cfg.slo.deadline_ms or 50.0)
+        adm.check(rows=buckets[0], queued_rows=0,
+                  max_queue_rows=64 * buckets[-1], deadline_s=None)
+        try:
+            adm.check(rows=buckets[0], queued_rows=128 * buckets[-1],
+                      max_queue_rows=128 * buckets[-1], deadline_s=None)
+        except DeadlineRejectedError:
+            pass  # the saturated-queue rejection is the designed outcome
+        budget = TokenBudget(cfg.slo.retry_budget_pct / 100.0)
+        budget.note_request()
+        budget.try_spend()
+        hedge = HedgeController(
+            slo_budget_ms=cfg.slo.deadline_ms or 50.0,
+            after_pct=cfg.slo.hedge_after_pct,
+            budget=TokenBudget(cfg.slo.hedge_budget_pct / 100.0),
+        )
+        hedge.plan(200.0)
+        gate = LoadShedGate()
+        gate.note(True)
+        gate.allow_shadow()
+        scaler = AutoScaler(min_groups=cfg.slo.min_groups,
+                            max_groups=cfg.slo.max_groups)
+        for tick in range(10):
+            scaler.observe(float(tick), groups=1, util=0.95)
+    except Exception as e:
+        out.append(_finding(
+            "trace-control-plane",
+            f"constructing/feeding the SLO control plane raised "
+            f"{type(e).__name__}: {e} — the policy layer must run as "
+            f"plain host code (no device, no trace, no jax)",
+            hint="serve/control/ holds pure host policy; keep jax out "
+                 "of it",
+            where=where, slug="ctl-host-policy",
+        ))
+        return out
+    # with that control plane alive, the serving predict must lower
+    # exactly as it would without one
+    from ..serve.reload import build_predict_with
+
+    f = cfg.model.field_size
+    b = buckets[0]
+    args = (
+        jax.ShapeDtypeStruct((b, f), jax.numpy.int64),
+        jax.ShapeDtypeStruct((b, f), jax.numpy.float32),
+    )
+    model, payload = _abstract_payload(cfg)
+    build_p = predict_builder or build_predict_with
+    texts: list[str] = []
+    try:
+        with jax.transfer_guard("disallow"):
+            for _ in range(2):
+                texts.append(
+                    build_p(model, cfg).lower(payload, *args).as_text()
+                )
+    except Exception as e:
+        out.append(_finding(
+            "trace-control-plane",
+            f"lowering the serving predict with the SLO control plane "
+            f"active raised {type(e).__name__}: {e} — an admission or "
+            f"scale decision ran under trace (closed over a traced "
+            f"value, or forced an implicit transfer)",
+            hint="admission prices requests BEFORE dispatch on the host "
+                 "(serve/batcher.py score); decisions never read traced "
+                 "values",
+            where=where, slug="ctl-predict-lower",
+        ))
+        return out
+    cb_lines = [
+        ln.strip()[:160] for ln in texts[0].splitlines()
+        if "custom_call" in ln and _CALLBACK_MARKER in ln.lower()
+    ]
+    if cb_lines:
+        out.append(_finding(
+            "trace-control-plane",
+            f"the serving predict lowers WITH a host callback under the "
+            f"SLO control plane ({len(cb_lines)} custom_call(s), first: "
+            f"{cb_lines[0]!r}) — a control decision (autoscale/hedge/"
+            f"admission) was smuggled into the graph via io_callback and "
+            f"will sync the device on every dispatch",
+            hint="the control loop reads router/engine snapshots on host "
+                 "threads (serve/pool/__main__.py); nothing decides "
+                 "inside jit",
+            where=where, slug="ctl-predict-callback",
+        ))
+    if len(texts) > 1 and texts[0] != texts[1]:
+        out.append(_finding(
+            "trace-control-plane",
+            "two successive lowerings of the serving predict differ "
+            "under the live control plane — a control-plane reading "
+            "(utilization EWMA, token count, cost estimate) was baked "
+            "into the trace as a constant, so every retrace builds a "
+            "different executable",
+            hint="control state changes per request; a graph that "
+                 "embeds it recompiles per decision — read it on the "
+                 "host at admission time instead",
+            where=where, slug="ctl-predict-nondeterministic",
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # zero-update contract (ZeRO dp-sharded weight update, train/optimizer.py +
 # parallel/spmd.py)
 
@@ -2013,4 +2162,5 @@ def run_trace_audit(cfg=None) -> list[Finding]:
     findings.extend(audit_funnel(cfg))
     findings.extend(audit_elastic(cfg))
     findings.extend(audit_observability(cfg))
+    findings.extend(audit_control_plane(cfg))
     return findings

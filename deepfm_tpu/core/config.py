@@ -572,6 +572,124 @@ class FleetConfig:
 
 
 @dataclass(frozen=True)
+class SloConfig:
+    """SLO-driven adaptive serving control plane (``deepfm_tpu/serve/
+    control``): deadline-aware admission at the micro-batcher, router-
+    level hedged tail requests, and elastic shard-group autoscaling.
+    Everything here is HOST-side control policy — the ``audit_control_
+    plane`` trace contract proves none of it enters the jitted predict.
+
+    Graceful degradation is the invariant the knobs parameterize: shed
+    the cheapest work first (shadow offers, then funnel width, then
+    plain predicts), never fail work already admitted, always converge
+    back (hysteresis on every edge)."""
+
+    # request completion SLO in milliseconds — the default deadline for
+    # requests that carry no ``X-Deadline-Ms`` header, AND the hedge
+    # trigger budget (a group whose live p95 exceeds this is hedge-
+    # eligible).  0 disables deadline admission and hedging.
+    deadline_ms: float = 0.0
+    # hedge delay as a percent of the first-choice group's live p95: the
+    # hedge fires only after the primary has already outlived this share
+    # of the typical tail (a p95-based adaptive delay — near-zero extra
+    # load when the group is healthy)
+    hedge_after_pct: float = 95.0
+    # hedges may add at most this percent extra load (token bucket over
+    # the recent request rate; an exhausted bucket suppresses hedging,
+    # never the primary request)
+    hedge_budget_pct: float = 5.0
+    # cross-group retries share a token bucket accruing at this percent
+    # of the recent request rate; beyond it the router fails fast with
+    # 503 + Retry-After instead of amplifying a pool-wide brownout
+    retry_budget_pct: float = 10.0
+    # -- priority shed ladder (cheapest first; utilizations in [0,1] of
+    # the admission queue bound, EWMA-smoothed so a single burst does
+    # not flip levels) ------------------------------------------------
+    # level 1: shed shadow-scoring offers (zero user impact)
+    shed_shadow_util: float = 0.60
+    # level 2: degrade recommend expand/rank width toward the floor
+    degrade_util: float = 0.75
+    # level 3: shed plain predicts at admission (503 + Retry-After)
+    shed_predict_util: float = 0.90
+    # recommend width floor under level-2 degradation, percent of the
+    # requested top_k/return_n (100 = never degrade)
+    degrade_floor_pct: float = 50.0
+    # -- elastic shard-group autoscaling --------------------------------
+    min_groups: int = 1
+    max_groups: int = 4
+    # scale up when utilization stays above this (or p95 stays over
+    # deadline_ms) for scale_up_window_secs
+    scale_up_util: float = 0.75
+    # scale down when utilization stays below this for
+    # scale_down_window_secs (strictly below scale_up_util: the gap is
+    # the hysteresis band that prevents flapping)
+    scale_down_util: float = 0.25
+    scale_up_window_secs: float = 5.0
+    scale_down_window_secs: float = 30.0
+    # minimum seconds between autoscale actions (lets a fresh group's
+    # load signal settle before the next decision)
+    cooldown_secs: float = 10.0
+
+    def __post_init__(self):
+        import math
+
+        for name in ("deadline_ms",):
+            v = getattr(self, name)
+            if not (v >= 0 and math.isfinite(v)):
+                raise ValueError(
+                    f"slo.{name} must be finite and >= 0, got {v}"
+                )
+        for name in ("hedge_after_pct", "hedge_budget_pct",
+                     "retry_budget_pct", "degrade_floor_pct"):
+            v = getattr(self, name)
+            if not (0.0 <= v <= 100.0 and math.isfinite(v)):
+                raise ValueError(
+                    f"slo.{name} must be a percent in [0, 100], got {v}"
+                )
+        for name in ("shed_shadow_util", "degrade_util",
+                     "shed_predict_util", "scale_up_util",
+                     "scale_down_util"):
+            v = getattr(self, name)
+            if not (0.0 < v <= 1.0 and math.isfinite(v)):
+                raise ValueError(
+                    f"slo.{name} must be a utilization in (0, 1], got {v}"
+                )
+        if not (self.shed_shadow_util <= self.degrade_util
+                <= self.shed_predict_util):
+            raise ValueError(
+                f"slo shed ladder must be ordered cheapest-first: "
+                f"shed_shadow_util={self.shed_shadow_util} <= "
+                f"degrade_util={self.degrade_util} <= "
+                f"shed_predict_util={self.shed_predict_util} — shedding "
+                f"plain predicts before shadow offers inverts graceful "
+                f"degradation"
+            )
+        if self.min_groups < 1:
+            raise ValueError(
+                f"slo.min_groups must be >= 1, got {self.min_groups}"
+            )
+        if self.max_groups < self.min_groups:
+            raise ValueError(
+                f"slo.max_groups={self.max_groups} < min_groups="
+                f"{self.min_groups}"
+            )
+        if self.scale_down_util >= self.scale_up_util:
+            raise ValueError(
+                f"slo.scale_down_util={self.scale_down_util} must stay "
+                f"strictly below scale_up_util={self.scale_up_util}: the "
+                f"gap is the hysteresis band — without it the autoscaler "
+                f"flaps a group up and down on every load ripple"
+            )
+        for name in ("scale_up_window_secs", "scale_down_window_secs",
+                     "cooldown_secs"):
+            v = getattr(self, name)
+            if not (v > 0 and math.isfinite(v)):
+                raise ValueError(
+                    f"slo.{name} must be finite and > 0, got {v}"
+                )
+
+
+@dataclass(frozen=True)
 class RunConfig:
     """Run/driver config: task dispatch + paths (ps:70-79) + cluster identity
     (SM_HOSTS/SM_CURRENT_HOST analogs, ps:80-95)."""
@@ -686,6 +804,7 @@ class Config:
     run: RunConfig = field(default_factory=RunConfig)
     elastic: ElasticConfig = field(default_factory=ElasticConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
+    slo: SloConfig = field(default_factory=SloConfig)
 
     def __post_init__(self):
         """Cross-section contracts no single section can check.
@@ -908,6 +1027,7 @@ class Config:
             fleet=FleetConfig(
                 **known(FleetConfig, d.get("fleet", {}), "fleet")
             ),
+            slo=SloConfig(**known(SloConfig, d.get("slo", {}), "slo")),
         )
 
     @classmethod

@@ -70,6 +70,13 @@ class ShadowScorer:
         self._c_scored = events.labels(challenger, "scored")
         self._c_shed = events.labels(challenger, "shed")
         self._c_errors = events.labels(challenger, "error")
+        # offers dropped by the router's load-shed gate BEFORE sampling —
+        # the first rung of the SLO shed ladder (serve/control/admission
+        # LoadShedGate): under sustained member backpressure the
+        # challenger loses samples at the source, the incumbent loses
+        # nothing
+        self._c_gated = events.labels(challenger, "gated")
+        self._gate = None
         # raw |challenger - incumbent| probability gap per request (mean
         # over the request's rows) — NOT a latency; snapshot scale=1
         self._divergence = self.registry.histogram(
@@ -82,6 +89,13 @@ class ShadowScorer:
         self._forward = forward
         return self
 
+    def set_gate(self, gate) -> "ShadowScorer":
+        """Attach a zero-arg shed gate (``gate() -> bool``, True =
+        offers allowed); a False answer sheds the offer before sampling
+        and counts it as ``gated``."""
+        self._gate = gate
+        return self
+
     def set_sample_percent(self, percent: float) -> None:
         """Retune the hash-stable sampling gate live (the bench's paired
         toggled-window design flips it per window; operators ramp it)."""
@@ -92,6 +106,9 @@ class ShadowScorer:
         """Offer one live (request, incumbent answer) pair.  Hash-stable
         sampling per key; a full queue sheds.  Returns True when
         enqueued."""
+        if self._gate is not None and not self._gate():
+            self._c_gated.inc()
+            return False
         if not sampled(key, self._sample_percent):
             return False
         self._c_offered.inc()
@@ -180,6 +197,7 @@ class ShadowScorer:
             "offered_total": offered,
             "scored_total": int(self._c_scored.value),
             "shed_total": shed,
+            "gated_total": int(self._c_gated.value),
             "errors_total": int(self._c_errors.value),
             "shed_rate": round(shed / offered, 4) if offered else 0.0,
             "divergence": self._divergence.snapshot(scale=1.0, digits=6),

@@ -60,6 +60,7 @@ import numpy as np
 
 from ..obs.metrics import MetricsRegistry
 from ..obs.trace import current_trace
+from .control.admission import DeadlineExpiredError
 
 
 class OverloadedError(RuntimeError):
@@ -184,6 +185,11 @@ class _Metrics:
             "end-to-end request latency through the engine",
             labels=("engine",), window=window,
         ).labels(name)
+        self._expired = r.counter(
+            "deepfm_serve_expired_total",
+            "requests whose deadline passed while queued (answered 504 "
+            "at dequeue, never dispatched)", labels=("engine",),
+        ).labels(name)
 
     def record_admit(self, rows: int) -> None:
         self._requests.inc()
@@ -195,6 +201,9 @@ class _Metrics:
     def record_dispatch(self, bucket: int, rows: int) -> None:
         self._padded.inc(bucket - rows)
         self._dispatch_by_bucket[bucket].inc()
+
+    def record_expired(self) -> None:
+        self._expired.inc()
 
     def record_latency(self, seconds: float) -> None:
         self._latency.observe(seconds)
@@ -210,6 +219,7 @@ class _Metrics:
             "dispatches_total": sum(hist.values()),
             "padded_rows_total": int(self._padded.value),
             "rejected_total": int(self._rejected.value),
+            "expired_total": int(self._expired.value),
             "batch_size_hist": hist,
             "latency_ms": self._latency.snapshot(include_max=True),
         }
@@ -243,7 +253,20 @@ class MicroBatcher:
     :meth:`precompile`).  Same call surface as the old ``Scorer``
     (``score`` / ``score_instances``) so handlers and benchmarks swap
     engines freely.
+
+    With an :class:`~.control.admission.AdmissionController` attached the
+    engine additionally prices every arrival against its deadline
+    (explicit ``deadline_s`` — the ``X-Deadline-Ms`` header made
+    absolute — or the controller's config default) BEFORE it occupies
+    queue slots, sheds by priority class under sustained saturation, and
+    answers 504 at dequeue for requests whose deadline passed while
+    queued — their bucket slots are backfilled from the queue before any
+    padding is computed, so a stale request never costs a dispatch.
     """
+
+    # handlers probe this before passing deadline/priority kwargs (the
+    # single-lock benchmark Scorer and other engines don't take them)
+    supports_deadline = True
 
     def __init__(
         self,
@@ -255,6 +278,7 @@ class MicroBatcher:
         max_queue_rows: int | None = None,
         name: str = "predict",
         registry: MetricsRegistry | None = None,
+        admission=None,
     ):
         if not buckets:
             raise ValueError("need at least one bucket size")
@@ -279,6 +303,11 @@ class MicroBatcher:
         # (served by GET /metrics); None keeps the engine hermetic
         self.metrics = _Metrics(self._buckets, name=name, registry=registry)
         self.registry = self.metrics.registry
+        # deadline-aware cost-based admission (serve/control/admission.py):
+        # None keeps the legacy bound-only backpressure.  The controller is
+        # shareable across a member's per-tenant engines (one cost model —
+        # the tenants dispatch through the SAME executables)
+        self.admission = admission
         self._g_queue_rows = self.registry.gauge(
             "deepfm_serve_queue_rows", "rows queued awaiting dispatch",
             labels=("engine",),
@@ -289,9 +318,18 @@ class MicroBatcher:
         ).labels(name)
         self.registry.on_collect(self._refresh_queue_gauges)
         self._cond = threading.Condition()
-        # queue items: (request, req_offset, ids_chunk, vals_chunk, arrival)
+        # queue items: (request, req_offset, ids_chunk, vals_chunk,
+        # arrival, deadline)  — deadline is absolute perf_counter seconds
+        # or None; checked at dequeue (expired chunks answer 504 and
+        # their slots backfill)
         self._queue: deque[tuple] = deque()
         self._queued_rows = 0
+        # the dispatch currently executing, as (bucket_rows, started_at)
+        # — admission prices its REMAINING time ahead of the queue drain
+        # (in-flight work is invisible to queue depth, yet every arrival
+        # waits behind it: without this the deadline promise can run one
+        # full bucket's service time late)
+        self._inflight_dispatch: tuple[int, float] | None = None
         self._closed = False
         self._worker = threading.Thread(
             target=self._run, daemon=True, name=f"micro-batcher-{name}"
@@ -323,12 +361,19 @@ class MicroBatcher:
             timings[b] = round(time.perf_counter() - t0, 4)
         return timings
 
-    def score(self, ids: np.ndarray, vals: np.ndarray) -> np.ndarray:
+    def score(self, ids: np.ndarray, vals: np.ndarray, *,
+              deadline_s: float | None = None,
+              priority: str = "predict") -> np.ndarray:
         """ids/vals [N, F] -> output [N] (or [N, D]); blocks until scored.
 
         Raises ``ValueError`` for malformed shapes (validated HERE, on the
-        caller's thread — a bad request never reaches the shared queue) and
-        :class:`OverloadedError` when the queue bound would be exceeded."""
+        caller's thread — a bad request never reaches the shared queue),
+        :class:`OverloadedError` when the queue bound would be exceeded,
+        and — with an admission controller attached —
+        ``DeadlineRejectedError``/``ShedError`` at admission (503 +
+        Retry-After upstream) or ``DeadlineExpiredError`` when
+        ``deadline_s`` (absolute ``time.perf_counter`` seconds) passed
+        while the request was queued (504 upstream)."""
         ids = np.asarray(ids, np.int64)
         vals = np.asarray(vals, np.float32)
         check_features(ids, vals, self._fields)
@@ -357,9 +402,21 @@ class MicroBatcher:
                     f"bound {self._max_queue_rows}); retry later"
                 )
             arrival = time.perf_counter()
+            if self.admission is not None:
+                # deadline pricing + the shed ladder, decided at the door
+                # (raises — nothing was enqueued yet, nothing to undo);
+                # returns the effective absolute deadline to stamp the
+                # queue items with
+                deadline_s = self.admission.check(
+                    rows=n, queued_rows=self._queued_rows,
+                    max_queue_rows=self._max_queue_rows,
+                    deadline_s=deadline_s, priority=priority, now=arrival,
+                    inflight=self._inflight_dispatch,
+                )
             for s in starts:
                 self._queue.append(
-                    (req, s, ids[s : s + cap], vals[s : s + cap], arrival)
+                    (req, s, ids[s : s + cap], vals[s : s + cap], arrival,
+                     deadline_s)
                 )
             self._queued_rows += n
             self._cond.notify()
@@ -376,8 +433,11 @@ class MicroBatcher:
             raise req.error
         return req.out
 
-    def score_instances(self, instances: list[dict]) -> np.ndarray:
-        return self.score(*instances_to_arrays(instances))
+    def score_instances(self, instances: list[dict], *,
+                        deadline_s: float | None = None,
+                        priority: str = "predict") -> np.ndarray:
+        return self.score(*instances_to_arrays(instances),
+                          deadline_s=deadline_s, priority=priority)
 
     def _refresh_queue_gauges(self) -> None:
         """Pre-scrape hook: surface live queue depth as gauges."""
@@ -399,6 +459,8 @@ class MicroBatcher:
             "queue_requests": queue_requests,
         }
         snap.update(self.metrics.snapshot())
+        if self.admission is not None:
+            snap["admission"] = self.admission.snapshot()
         return snap
 
     def close(self) -> None:
@@ -436,6 +498,7 @@ class MicroBatcher:
                         break
                     self._cond.wait(remaining)
                 batch, rows = [], 0
+                t_collect = time.perf_counter()
                 while self._queue and rows + self._queue[0][2].shape[0] \
                         <= self._buckets[-1]:
                     item = self._queue.popleft()
@@ -446,11 +509,37 @@ class MicroBatcher:
                         # with — an orphan chunk
                         self._queued_rows -= item[2].shape[0]
                         continue
+                    if item[5] is not None and t_collect > item[5]:
+                        # the deadline passed while queued: answer 504
+                        # NOW and keep collecting — the slot this chunk
+                        # would have taken backfills from the queue
+                        # before any padding is computed, so a bucket
+                        # of stale work dispatches nothing
+                        self._queued_rows -= item[2].shape[0]
+                        req = item[0]
+                        if req.error is None:
+                            req.error = DeadlineExpiredError(
+                                f"deadline passed while queued "
+                                f"({(t_collect - item[5]) * 1e3:.1f} ms "
+                                f"late at dequeue)"
+                            )
+                            self.metrics.record_expired()
+                        req.done.set()
+                        continue
                     batch.append(item)
                     rows += item[2].shape[0]
                 self._queued_rows -= rows
+                if batch:
+                    # visible to admission while the worker is busy
+                    self._inflight_dispatch = (
+                        self._pick_bucket(rows), time.perf_counter()
+                    )
             if batch:
-                self._dispatch(batch, rows)
+                try:
+                    self._dispatch(batch, rows)
+                finally:
+                    with self._cond:
+                        self._inflight_dispatch = None
 
     def _dispatch(self, batch: list[tuple], rows: int) -> None:
         bucket = self._pick_bucket(rows)
@@ -462,13 +551,17 @@ class MicroBatcher:
             ids = np.zeros((bucket, self._fields), np.int64)
             vals = np.zeros((bucket, self._fields), np.float32)
             off = 0
-            for _req, _ro, cids, cvals, _t in batch:
+            for _req, _ro, cids, cvals, *_ in batch:
                 ids[off : off + cids.shape[0]] = cids
                 vals[off : off + cids.shape[0]] = cvals
                 off += cids.shape[0]
             res = np.asarray(self._fn(ids, vals))
             self.metrics.record_dispatch(bucket, rows)
             t1 = time.perf_counter()
+            if self.admission is not None:
+                # the admission cost model eats the SAME host-side
+                # boundary the dispatch span records — per bucket shape
+                self.admission.cost.observe(bucket, t1 - t0)
             for req, *_ in batch:
                 if req.trace is not None:
                     # host-side timer AROUND the dispatch boundary — the
@@ -478,7 +571,7 @@ class MicroBatcher:
                         rows_coalesced=rows, padded=bucket - rows,
                     )
             off = 0
-            for req, req_off, cids, _cv, _t in batch:
+            for req, req_off, cids, *_ in batch:
                 k = cids.shape[0]
                 if req.out is None:
                     req.out = np.empty(

@@ -1,0 +1,37 @@
+"""SLO-driven adaptive serving control plane.
+
+The layer that turns the obs stack's measurements into actions, with
+graceful degradation as the invariant: shed the cheapest work first,
+never fail work already admitted, always converge back.
+
+* :mod:`cost` — per-bucket online dispatch cost model (EWMA over the
+  engine's ``dispatch`` span timings) the admission decision prices
+  queue drain against.
+* :mod:`admission` — deadline-aware cost-based admission for the
+  MicroBatcher plus the priority shed ladder (shadow offers first,
+  then recommend width, then plain predicts) and the router-side
+  shadow shed gate.
+* :mod:`hedge` — the shared retry/hedge token budget and the
+  p95-adaptive hedged-request policy.
+* :mod:`autoscale` — the elastic shard-group scaling decision logic
+  (sustained-breach/sustained-slack hysteresis, cooldown, bounds);
+  the pool supervisor (serve/pool/__main__.py) executes its decisions.
+
+Everything in this package is HOST-side policy over host-side
+measurements.  None of it may enter the jitted predict — the
+``audit_control_plane`` trace contract (analysis/trace_audit.py) lowers
+the serving predict with the whole control plane constructed and active
+and proves the module is unchanged: transfer-guard-clean, no callback
+custom_calls, deterministic across fresh builds.
+"""
+
+from .admission import (  # noqa: F401
+    AdmissionController,
+    DeadlineExpiredError,
+    DeadlineRejectedError,
+    LoadShedGate,
+    ShedError,
+)
+from .autoscale import AutoScaler  # noqa: F401
+from .cost import BucketCostModel  # noqa: F401
+from .hedge import HedgeController, TokenBudget  # noqa: F401

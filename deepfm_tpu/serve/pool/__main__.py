@@ -45,6 +45,30 @@ def _load_tenants(arg: str):
     return parse_tenants(text)
 
 
+def _parse_slo(arg: str):
+    """Parse ``--slo``: inline JSON, or ``@path`` to a JSON file, with
+    the keys of :class:`~...core.config.SloConfig`.  Unknown keys are an
+    error here (operator CLI, not a forward-compatible config file)."""
+    import dataclasses
+
+    from ...core.config import SloConfig
+
+    if not arg:
+        return None
+    text = arg
+    if arg.startswith("@"):
+        with open(arg[1:]) as f:
+            text = f.read()
+    d = json.loads(text)
+    names = {f.name for f in dataclasses.fields(SloConfig)}
+    unknown = sorted(set(d) - names)
+    if unknown:
+        raise SystemExit(
+            f"--slo: unknown key(s) {unknown}; valid: {sorted(names)}"
+        )
+    return SloConfig(**d)
+
+
 def _member_argv(args, group: str, index: int, port: int) -> list[str]:
     argv = [
         sys.executable, "-m", "deepfm_tpu.serve.pool", "--member-entry",
@@ -56,6 +80,8 @@ def _member_argv(args, group: str, index: int, port: int) -> list[str]:
     ]
     if args.exchange:
         argv += ["--exchange", args.exchange]
+    if args.slo:
+        argv += ["--slo", args.slo]
     if args.reload_url:
         argv += ["--reload-url", args.reload_url]
     if args.tenants:
@@ -137,8 +163,119 @@ def _run_member(args) -> int:
         funnel_top_k=args.funnel_top_k,
         funnel_return_n=args.funnel_return_n,
         tenants=_load_tenants(args.tenants) or None,
+        slo=_parse_slo(args.slo),
     )
     return 0
+
+
+def _start_autoscaler(args, slo, router, shutdown, state_lock, groups,
+                      start_group, stop_group) -> threading.Thread:
+    """The elastic shard-group control loop (the execution half of
+    serve/control/autoscale.py): every second, fold the router's
+    aggregate utilization + worst-group p95 into the AutoScaler; on
+    "up", spawn a member, wait out its ``/readyz`` gate, admit it to the
+    ring; on "down", stop admitting to the emptiest group, wait its
+    in-flight to zero, terminate it.  Runs OUTSIDE any jitted graph —
+    pure host threads over HTTP; audit_control_plane pins that."""
+    import time
+
+    from ..control.autoscale import AutoScaler
+
+    scaler = AutoScaler(
+        min_groups=(slo.min_groups if slo else 1),
+        max_groups=(slo.max_groups if slo else 4),
+        up_util=(slo.scale_up_util if slo else 0.75),
+        down_util=(slo.scale_down_util if slo else 0.25),
+        slo_ms=(slo.deadline_ms if slo else 0.0),
+        up_window_secs=(slo.scale_up_window_secs if slo else 5.0),
+        down_window_secs=(slo.scale_down_window_secs if slo else 30.0),
+        cooldown_secs=(slo.cooldown_secs if slo else 10.0),
+    )
+    largest = max(int(x) for x in args.buckets.split(","))
+
+    def _ready(url: str, timeout_secs: float = 180.0) -> bool:
+        import urllib.request
+
+        deadline = time.monotonic() + timeout_secs
+        while time.monotonic() < deadline and not shutdown.is_set():
+            try:
+                with urllib.request.urlopen(url + "/readyz",
+                                            timeout=2) as r:
+                    if json.load(r).get("ready"):
+                        return True
+            # da:allow[swallowed-exception] readiness poll: refused/reset while the group warms up IS the not-ready signal; the deadline bounds the loop
+            except Exception:
+                pass
+            time.sleep(0.5)
+        return False
+
+    def _scale_up() -> None:
+        with state_lock:
+            used = {st["index"] for st in groups.values()}
+        index = next(i for i in range(4096) if i not in used)
+        name = f"g{index}"
+        url = start_group(name, index)
+        # stage -> ready -> admit: the new group takes ZERO traffic
+        # until its engine precompiled and weights loaded (/readyz)
+        if not _ready(url):
+            print(f"pool: scale-up {name} never became ready; "
+                  f"tearing it back down", file=sys.stderr)
+            stop_group(name)
+            scaler.note_scaled(time.monotonic())
+            return
+        router.add_group(name, [url])
+        print(f"pool: scaled UP: admitted {name} at {url}",
+              file=sys.stderr)
+        scaler.note_scaled(time.monotonic())
+
+    def _scale_down() -> None:
+        live = router.group_names()
+        with state_lock:
+            candidates = [g for g in live if g in groups]
+        if len(candidates) <= 1:
+            return
+        # the emptiest group drains fastest (graceful degradation:
+        # admitted work always finishes)
+        victim = min(candidates, key=router.group_inflight)
+        router.remove_group(victim)           # stop admitting
+        deadline = time.monotonic() + 60.0
+        while (router.group_inflight(victim) > 0
+               and time.monotonic() < deadline):
+            time.sleep(0.1)                   # wait out in-flight
+        stop_group(victim)                    # terminate
+        print(f"pool: scaled DOWN: drained and removed {victim}",
+              file=sys.stderr)
+        scaler.note_scaled(time.monotonic())
+
+    def _loop() -> None:
+        while not shutdown.wait(1.0):
+            try:
+                snap = router.metrics_snapshot()
+                gs = snap["groups"]
+                n = len(gs) or 1
+                # utilization: router-tracked in-flight rows against the
+                # pool's one-big-dispatch-per-group capacity proxy
+                util = (sum(g["inflight_rows"] for g in gs.values())
+                        / (n * largest))
+                p95s = [(g.get("latency_ms") or {}).get("p95")
+                        for g in gs.values()]
+                p95s = [p for p in p95s if p is not None]
+                action = scaler.observe(
+                    time.monotonic(), groups=n, util=util,
+                    p95_ms=max(p95s) if p95s else None,
+                )
+                if action == "up":
+                    _scale_up()
+                elif action == "down":
+                    _scale_down()
+            except Exception as e:
+                # the control loop must outlive any one bad sample
+                print(f"pool: autoscale loop error: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+
+    t = threading.Thread(target=_loop, daemon=True, name="autoscaler")
+    t.start()
+    return t
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -188,6 +325,24 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--funnel-return-n", type=int, default=0,
                     help="funnel servables: ranked items returned per "
                          "user (0 = the servable's funnel.json default)")
+    ap.add_argument(
+        "--slo", default="",
+        help="SLO control plane (serve/control/): inline JSON or @file "
+             "with SloConfig keys (core/config.py) — deadline_ms turns "
+             "on deadline-aware admission at every member and arms "
+             "router hedging; retry_budget_pct/hedge_budget_pct cap the "
+             "retry/hedge token buckets; shed_*_util set the priority "
+             "shed ladder; min/max_groups + scale_*_util bound the "
+             "autoscaler",
+    )
+    ap.add_argument(
+        "--autoscale", action="store_true",
+        help="elastic shard-groups: watch router utilization + SLO "
+             "attainment, spawn a group (stage -> /readyz -> admit) on "
+             "sustained breach, drain the emptiest on sustained slack "
+             "(bounded by --slo min_groups/max_groups; requires "
+             "--router)",
+    )
     ap.add_argument("--retry-limit", type=int, default=2)
     ap.add_argument("--eject-after", type=int, default=2)
     ap.add_argument("--health-interval", type=float, default=1.0)
@@ -230,46 +385,78 @@ def main(argv: list[str] | None = None) -> int:
         # excepthook) plus clean/killed shutdown both leave the timeline
         obs_flight.install(args.flight_dump)
 
-    stop = threading.Event()
-    group_names = [f"g{i}" for i in range(args.groups)]
-    ports = {g: args.member_port_base + i
-             for i, g in enumerate(group_names)}
-    supervisors = [
-        threading.Thread(
-            target=_supervise_member, args=(args, g, i, ports[g], stop),
-            daemon=True, name=f"supervise-{g}",
-        )
-        for i, g in enumerate(group_names)
-    ]
-    for t in supervisors:
-        t.start()
-    urls = {g: [f"http://{args.host}:{ports[g]}"] for g in group_names}
-    print(f"pool: {args.groups} shard-group(s) at "
-          f"{ {g: u[0] for g, u in urls.items()} }", file=sys.stderr)
-
     tenant_specs = _load_tenants(args.tenants)
-    swappers = []
-    if tenant_specs:
+    slo = _parse_slo(args.slo)
+    if args.autoscale and not args.router:
+        ap.error("--autoscale requires --router (the router aggregates "
+                 "the utilization/SLO signal the scaler watches)")
+
+    # per-group lifecycle state: the autoscaler stops ONE group's member
+    # without touching its siblings, so each group owns its stop event,
+    # supervisor thread and swap coordinators
+    shutdown = threading.Event()
+    state_lock = threading.Lock()
+    groups: dict[str, dict] = {}
+
+    def _start_swappers(g: str, url: str) -> list:
         # one group-atomic coordinator per (group, tenant-with-a-source):
         # each polls ITS tenant's manifest stream and converges only that
         # tenant's per-member slots
-        from .swap import GroupSwapper
+        out = []
+        if tenant_specs:
+            from .swap import GroupSwapper
 
-        for g in group_names:
             for spec in tenant_specs:
                 if spec.source:
-                    swappers.append(GroupSwapper(
-                        urls[g], spec.source, group=g, tenant=spec.name,
+                    out.append(GroupSwapper(
+                        [url], spec.source, group=g, tenant=spec.name,
                         interval_secs=args.reload_interval,
                     ).start())
-    elif args.reload_url:
-        from .swap import GroupSwapper
+        elif args.reload_url:
+            from .swap import GroupSwapper
 
-        for g in group_names:
-            swappers.append(GroupSwapper(
-                urls[g], args.reload_url, group=g,
+            out.append(GroupSwapper(
+                [url], args.reload_url, group=g,
                 interval_secs=args.reload_interval,
             ).start())
+        return out
+
+    def _start_group(g: str, index: int) -> str:
+        """Spawn one supervised member process for group ``g``; returns
+        its base URL (it is NOT ready yet — the member still has to load
+        and precompile behind its /readyz gate)."""
+        port = args.member_port_base + index
+        stop = threading.Event()
+        t = threading.Thread(
+            target=_supervise_member, args=(args, g, index, port, stop),
+            daemon=True, name=f"supervise-{g}",
+        )
+        t.start()
+        url = f"http://{args.host}:{port}"
+        with state_lock:
+            groups[g] = {"stop": stop, "thread": t, "index": index,
+                         "url": url, "swappers": _start_swappers(g, url)}
+        return url
+
+    def _stop_group(g: str) -> None:
+        """Terminate one group's member process and coordinators (the
+        caller already stopped admitting traffic and waited out the
+        drain)."""
+        with state_lock:
+            st = groups.pop(g, None)
+        if st is None:
+            return
+        for s in st["swappers"]:
+            s.stop()
+        st["stop"].set()
+        st["thread"].join(timeout=40)
+
+    for i in range(args.groups):
+        _start_group(f"g{i}", i)
+    with state_lock:
+        urls = {g: [st["url"]] for g, st in groups.items()}
+    print(f"pool: {args.groups} shard-group(s) at "
+          f"{ {g: u[0] for g, u in urls.items()} }", file=sys.stderr)
 
     try:
         if args.router:
@@ -301,13 +488,35 @@ def main(argv: list[str] | None = None) -> int:
                     )
                     for challenger, incumbent in reg.shadow_pairs()
                 ]
+            # the SLO control plane (serve/control/): shared retry
+            # budget, tail hedging (needs a deadline to define "tail"),
+            # and the shadow shed gate — all off without --slo
+            retry_budget = hedge = shed_gate = None
+            if slo is not None:
+                from ..control.admission import LoadShedGate
+                from ..control.hedge import HedgeController, TokenBudget
+
+                retry_budget = TokenBudget(slo.retry_budget_pct / 100.0)
+                shed_gate = LoadShedGate()
+                if slo.deadline_ms > 0:
+                    hedge = HedgeController(
+                        slo_budget_ms=slo.deadline_ms,
+                        after_pct=slo.hedge_after_pct,
+                        budget=TokenBudget(slo.hedge_budget_pct / 100.0),
+                    )
             router = Router(
                 urls, model_name=args.model_name,
                 retry_limit=args.retry_limit,
                 eject_after=args.eject_after,
                 probe_interval_secs=args.health_interval,
                 split=split, shadow=shadow, registry=registry,
+                retry_budget=retry_budget, hedge=hedge,
+                shed_gate=shed_gate,
             ).start()
+            if args.autoscale:
+                _start_autoscaler(args, slo, router, shutdown,
+                                  state_lock, groups,
+                                  _start_group, _stop_group)
             httpd = ScoringHTTPServer(
                 (args.host, args.port), make_router_handler(router)
             )
@@ -323,11 +532,17 @@ def main(argv: list[str] | None = None) -> int:
     except KeyboardInterrupt:
         pass
     finally:
-        stop.set()
-        for s in swappers:
-            s.stop()
-        for t in supervisors:
-            t.join(timeout=40)
+        shutdown.set()
+        # stop every group's member + coordinators: signal all first,
+        # then join, so teardown is parallel not serial
+        with state_lock:
+            snapshot = list(groups.items())
+        for _g, st in snapshot:
+            for s in st["swappers"]:
+                s.stop()
+            st["stop"].set()
+        for _g, st in snapshot:
+            st["thread"].join(timeout=40)
         if args.flight_dump:
             from ...obs import flight as obs_flight
 

@@ -49,12 +49,28 @@ run in the supervisor process (serve/pool/__main__.py) or any sidecar.
   ejections/re-admissions, each group's exchange wire-bytes estimate
   (cached from readiness probes), and — with a fleet — a ``tenants``
   section (per-tenant requests/latency/split share + shadow stats).
+
+SLO control plane (serve/control/, all optional):
+
+* **Retry/hedge token budget** (:class:`~..control.hedge.TokenBudget`):
+  every cross-group retry and every hedge spends one shared token;
+  tokens accrue at a fraction of the live request rate.  Exhaustion
+  FAILS FAST (503 + ``Retry-After``) — in a pool-wide brownout the
+  router must not multiply offered load by the retry factor.
+* **Hedged tail requests** (:class:`~..control.hedge.HedgeController`):
+  when the first-choice group's live p95 breaches the SLO budget, a
+  hedge to the next healthy candidate arms after an adaptive delay;
+  first answer wins, the loser counts as cancelled.
+* **Shadow shed gate** (:class:`~..control.admission.LoadShedGate`):
+  smoothed member-backpressure signal; while high, shadow offers shed
+  at the source (the first rung of the admission ladder).
 """
 
 from __future__ import annotations
 
 import hashlib
 import json
+import queue as _queue
 import threading
 import time
 import urllib.error
@@ -153,6 +169,9 @@ class Router:
         tracer: Tracer | None = None,
         split=None,
         shadow=None,
+        retry_budget=None,
+        hedge=None,
+        shed_gate=None,
     ):
         if not groups:
             raise ValueError("router needs at least one shard-group")
@@ -161,6 +180,9 @@ class Router:
         self._members = {
             g: [_Member(u) for u in urls] for g, urls in groups.items()
         }
+        # scale-down keeps the drained group's member records here so
+        # ``group_inflight`` stays answerable while requests finish
+        self._retired: dict[str, list[_Member]] = {}
         self._retry_limit = int(retry_limit)
         self._spread = max(1, int(spread))
         self._eject_after = max(1, int(eject_after))
@@ -198,14 +220,26 @@ class Router:
         self._c_no_capacity = r.counter(
             "deepfm_router_no_capacity_total",
             "requests refused with no healthy shard-group")
-        group_requests = r.counter(
+        # family refs kept: add_group mints new label children at runtime
+        self._f_group_requests = r.counter(
             "deepfm_router_group_requests_total",
             "requests answered per shard-group", labels=("group",))
-        latency = r.histogram(
+        self._f_latency = r.histogram(
             "deepfm_router_group_latency_seconds",
             "router-measured member latency", labels=("group",))
-        self._group_requests = {g: group_requests.labels(g) for g in groups}
-        self._windows = {g: latency.labels(g) for g in groups}
+        self._group_requests = {
+            g: self._f_group_requests.labels(g) for g in groups
+        }
+        self._windows = {g: self._f_latency.labels(g) for g in groups}
+        # SLO control plane (serve/control/), each optional: the shared
+        # retry/hedge token budget, the tail-hedging controller, and the
+        # shadow shed gate
+        self._retry_budget = retry_budget
+        self._hedge = hedge
+        self._shed_gate = shed_gate
+        self._c_budget_exhausted = r.counter(
+            "deepfm_router_retry_budget_exhausted_total",
+            "retries/hedges suppressed: shared token budget empty")
         # multi-tenant fleet (deepfm_tpu/fleet): the hash-stable split
         # picks each request's tenant (unless X-Tenant names one) and the
         # shadow(s) re-score a sampled slice of their incumbent's stream
@@ -223,6 +257,11 @@ class Router:
             # machinery, addressed to ITSELF, with re-offering disabled
             sh.bind(lambda body, _c=sh.challenger: self.handle_predict(
                 body, tenant=_c, _offer_shadow=False))
+            if self._shed_gate is not None:
+                # the shed ladder's first rung: while the gate reads
+                # sustained member backpressure, offers shed at the
+                # source (fleet/shadow.py counts them as "gated")
+                sh.set_gate(self._shed_gate.allow_shadow)
         # tenant label cardinality is BOUNDED: only names the fleet
         # actually serves (split arms, shadow pairs, tenants learned from
         # member readiness probes) get metric children — an arbitrary
@@ -315,7 +354,11 @@ class Router:
                                       fails=m.fails)
 
     def probe_once(self) -> None:
-        for g, members in self._members.items():
+        # snapshot under the lock: the autoscaler adds/removes groups
+        # from another thread while this loop is mid-iteration
+        with self._lock:
+            live = [(g, list(ms)) for g, ms in self._members.items()]
+        for g, members in live:
             for m in members:
                 self._probe_member(g, m)
 
@@ -366,6 +409,56 @@ class Router:
                           before=before, after=after)
         return after
 
+    # -- elastic topology ---------------------------------------------------
+    def add_group(self, name: str, urls: list[str]) -> None:
+        """Admit a new shard-group into rotation (the autoscaler's
+        scale-up commit, AFTER the members' ``/readyz`` passed).
+        Consistent hashing means only ≈K/n of K keys move to it; every
+        other key keeps its group."""
+        with self._lock:
+            if name in self._members:
+                raise ValueError(f"group {name!r} already routed")
+            self._retired.pop(name, None)
+            self._members[name] = [_Member(u) for u in urls]
+            self._group_requests[name] = self._f_group_requests.labels(name)
+            self._windows[name] = self._f_latency.labels(name)
+            # a fresh ring (not in-place mutation): ``candidates`` reads
+            # the point list lock-free, so the swap must be atomic
+            self._ring = HashRing(sorted(self._members))
+        obs_flight.record("group_added", subsystem="slo", group=name,
+                          urls=list(urls))
+        for m in self._members[name]:
+            self._probe_member(name, m)
+
+    def remove_group(self, name: str) -> None:
+        """Stop admitting to a group (the autoscaler's scale-down start).
+        In-flight requests on it finish normally — the member records
+        move to the retired set so ``group_inflight`` keeps answering
+        while the supervisor waits out the drain, then terminates the
+        processes.  Never removes the last group."""
+        with self._lock:
+            if name not in self._members:
+                raise ValueError(f"group {name!r} is not routed")
+            if len(self._members) <= 1:
+                raise ValueError("refusing to remove the last shard-group")
+            self._retired[name] = self._members.pop(name)
+            self._ring = HashRing(sorted(self._members))
+            stale = [k for k in self._generation if k[0] == name]
+            for k in stale:
+                del self._generation[k]
+        obs_flight.record("group_removed", subsystem="slo", group=name)
+
+    def group_inflight(self, name: str) -> int:
+        """Router-tracked in-flight rows on a group — live or retired
+        (the drain monitor's signal)."""
+        with self._lock:
+            members = self._members.get(name) or self._retired.get(name, [])
+            return sum(m.inflight for m in members)
+
+    def group_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._members)
+
     # -- routing ------------------------------------------------------------
     @staticmethod
     def request_key(body: dict) -> str:
@@ -380,7 +473,7 @@ class Router:
         ).hexdigest()
 
     def _healthy_members(self, group: str) -> list[_Member]:
-        return [m for m in self._members[group] if m.healthy]
+        return [m for m in self._members.get(group, ()) if m.healthy]
 
     def _plan(self, key: str) -> list[str]:
         """Candidate groups in try-order: ring order, with the first
@@ -403,7 +496,9 @@ class Router:
     def handle_predict(self, body: dict,
                        path: str | None = None,
                        tenant: str | None = None,
-                       _offer_shadow: bool = True) -> tuple[int, dict]:
+                       _offer_shadow: bool = True,
+                       deadline_ms: float | None = None,
+                       priority: str | None = None) -> tuple[int, dict]:
         """Route one predict (or funnel recommend — ``path`` overrides
         the default ``:predict`` member route; same pinning, ejection and
         retry discipline); returns ``(http_status, response_doc)``.  The
@@ -415,7 +510,14 @@ class Router:
         ``tenant`` is the explicit X-Tenant selection; with none and a
         split attached, the request's hash-stable split arm decides.
         ``_offer_shadow=False`` marks the shadow worker's own re-scores
-        (a challenger score must never re-offer itself)."""
+        (a challenger score must never re-offer itself).
+
+        ``deadline_ms``/``priority`` are the client's SLO declaration
+        (``X-Deadline-Ms``/``X-Priority``), forwarded to the member whose
+        admission controller prices them; with a
+        :class:`~..control.hedge.HedgeController` attached, a request
+        whose first-choice group's live p95 breaches the SLO budget races
+        a delayed hedge against the next healthy candidate."""
         target = path or f"/v1/models/{self.model_name}:predict"
         key = self.request_key(body)
         if tenant is None and self._split is not None:
@@ -423,6 +525,13 @@ class Router:
         rows = len(body.get("instances", []))
         plan = self._plan(key)
         self._c_requests.inc()
+        if self._retry_budget is not None:
+            # every routed request accrues fractional retry/hedge credit
+            self._retry_budget.note_request()
+        if (self._hedge is not None and self._hedge.budget is not None
+                and self._hedge.budget is not self._retry_budget):
+            # a hedge budget configured as its own bucket accrues too
+            self._hedge.budget.note_request()
         if tenant is not None and tenant in self._known_tenants:
             # known tenants only: a client-invented X-Tenant string is
             # forwarded (the member 400s it) but never mints a metric
@@ -436,135 +545,240 @@ class Router:
         if not plan:
             self._c_no_capacity.inc()
             return 503, {"error": "no healthy shard-group"}
-        payload = json.dumps(body).encode()
-        attempts = 0
-        last_err: dict = {"error": "exhausted"}
-        for group in plan[: self._retry_limit + 1]:
-            members = sorted(
-                self._healthy_members(group), key=lambda m: m.inflight
+        kw = dict(
+            target=target, payload=json.dumps(body).encode(), rows=rows,
+            tenant=tenant, tctx=tctx, key=key, body=body,
+            _offer_shadow=_offer_shadow, deadline_ms=deadline_ms,
+            priority=priority,
+        )
+        groups = plan[: self._retry_limit + 1]
+        delay = None
+        if self._hedge is not None and len(groups) > 1:
+            delay = self._hedge.plan(
+                self._windows[groups[0]].snapshot().get("p95")
             )
-            if not members:
-                continue
-            m = members[0]
-            # one in-group re-pin retry: a 409 means OUR generation was
-            # stale (the group swapped under us), not that the group is bad
-            for pin_attempt in range(2):
-                attempts += 1
-                if attempts > 1:
-                    self._c_retries.inc()
-                gen = self._generation.get((group, tenant))
-                headers = {"Content-Type": "application/json"}
+        if delay is None:
+            return self._route(groups, **kw)
+        return self._route_hedged(groups, delay, **kw)
+
+    def _route(self, groups: list[str], **kw) -> tuple[int, dict]:
+        """Sequential failover over the candidate groups.  Every group
+        past the first is a cross-group retry and spends one shared
+        budget token first; an empty bucket FAILS FAST — retrying into a
+        pool-wide brownout multiplies the offered load exactly when
+        capacity is scarcest."""
+        state = {"attempts": 0, "last_err": {"error": "exhausted"}}
+        for i, group in enumerate(groups):
+            if i > 0 and self._retry_budget is not None \
+                    and not self._retry_budget.try_spend():
+                self._c_budget_exhausted.inc()
+                return 503, {
+                    "error": "retry budget exhausted (pool-wide "
+                             "brownout guard): failing fast",
+                    "retry_after_s": 1.0,
+                }
+            out = self._try_group(group, state=state, **kw)
+            if out is not None:
+                return out
+        return 503, state["last_err"]
+
+    def _route_hedged(self, groups: list[str], delay: float,
+                      **kw) -> tuple[int, dict]:
+        """Race the primary plan against a delayed hedge on the next
+        candidate.  The hedge fires only if the primary outlives
+        ``delay`` AND the shared token budget grants it (≤ the budget
+        ratio of recent request rate — bounded extra load by
+        construction).  First answer wins; the loser's work is counted
+        cancelled (nobody consumes it)."""
+        resq: _queue.Queue = _queue.Queue()
+
+        def run(subgroups: list[str], tag: str) -> None:
+            try:
+                resq.put((tag, self._route(subgroups, **kw)))
+            except Exception as e:   # defensive: a leg must always report
+                resq.put((tag, (500,
+                                {"error": f"{type(e).__name__}: {e}"})))
+
+        threading.Thread(target=run, args=(groups, "primary"),
+                         daemon=True, name="route-primary").start()
+        try:
+            _, out = resq.get(timeout=max(0.001, delay))
+            return out           # answered inside the hedge delay
+        except _queue.Empty:
+            pass
+        if not self._hedge.try_fire():
+            _, out = resq.get()  # budget empty: wait out the primary
+            return out
+        threading.Thread(target=run, args=([groups[1]], "hedge"),
+                         daemon=True, name="route-hedge").start()
+        first_tag, first = resq.get()
+        if first[0] == 200:
+            self._hedge.record_outcome(hedge_won=(first_tag == "hedge"))
+            first[1].setdefault("router", {})["hedge"] = first_tag
+            return first
+        # the first arrival failed — the race is decided by the other leg
+        second_tag, second = resq.get()
+        winner_tag, winner = ((second_tag, second) if second[0] == 200
+                              else (first_tag, first))
+        self._hedge.record_outcome(
+            hedge_won=(winner_tag == "hedge" and winner[0] == 200))
+        if winner[0] == 200:
+            winner[1].setdefault("router", {})["hedge"] = winner_tag
+        return winner
+
+    def _try_group(self, group: str, *, target: str, payload: bytes,
+                   rows: int, tenant: str | None, tctx, state: dict,
+                   key: str, body: dict, _offer_shadow: bool,
+                   deadline_ms: float | None = None,
+                   priority: str | None = None) -> tuple[int, dict] | None:
+        """One candidate group's forward: least-loaded member pick plus
+        one in-group re-pin retry.  Returns a terminal ``(status, doc)``
+        or None — this group cannot answer, try the next candidate."""
+        members = sorted(
+            self._healthy_members(group), key=lambda m: m.inflight
+        )
+        if not members:
+            return None
+        m = members[0]
+        # one in-group re-pin retry: a 409 means OUR generation was
+        # stale (the group swapped under us), not that the group is bad
+        for _pin_attempt in range(2):
+            state["attempts"] += 1
+            if state["attempts"] > 1:
+                self._c_retries.inc()
+            gen = self._generation.get((group, tenant))
+            headers = {"Content-Type": "application/json"}
+            if tenant is not None:
+                headers["X-Tenant"] = tenant
+            if gen is not None:
+                headers["X-Pinned-Generation"] = str(gen)
+            if deadline_ms is not None:
+                # the member's admission controller prices the request
+                # against this (made absolute on ITS clock at parse time)
+                headers["X-Deadline-Ms"] = str(deadline_ms)
+            if priority is not None:
+                headers["X-Priority"] = priority
+            if tctx is not None:
+                headers.update(tctx.headers())
+            req = urllib.request.Request(
+                f"{m.url}{target}", data=payload, headers=headers,
+            )
+            t0 = time.perf_counter()
+            with self._lock:
+                m.inflight += rows
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=self._timeout
+                ) as r:
+                    doc = json.load(r)
+                dt = time.perf_counter() - t0
+                self._windows[group].observe(dt)
+                self._group_requests[group].inc()
                 if tenant is not None:
-                    headers["X-Tenant"] = tenant
-                if gen is not None:
-                    headers["X-Pinned-Generation"] = str(gen)
-                if tctx is not None:
-                    headers.update(tctx.headers())
-                req = urllib.request.Request(
-                    f"{m.url}{target}", data=payload, headers=headers,
-                )
-                t0 = time.perf_counter()
+                    if tenant in self._known_tenants:
+                        self._tenant_latency.labels(tenant).observe(
+                            dt)
                 with self._lock:
-                    m.inflight += rows
+                    if "group_generation" in doc:
+                        self._generation[(group, tenant)] = int(
+                            doc["group_generation"]
+                        )
+                if self._shed_gate is not None:
+                    self._shed_gate.note(False)
+                if tctx is not None:
+                    span_attrs = {"group": group,
+                                  "attempt": state["attempts"],
+                                  "status": 200}
+                    if tenant is not None:
+                        span_attrs["tenant"] = tenant
+                    tctx.add_span(
+                        "router.forward", t0, time.perf_counter(),
+                        **span_attrs,
+                    )
+                doc["router"] = {"group": group,
+                                 "attempts": state["attempts"]}
+                if tenant is not None:
+                    doc["router"]["tenant"] = tenant
+                # shadow the incumbent's answered stream: a
+                # hash-stable sample is re-scored by each challenger
+                # off this path (bounded queue, sheds under load);
+                # the response below is already the incumbent's and
+                # never waits on it.  Gate on the tenant the member
+                # REPORTS scoring — a split-less fleet routes
+                # unkeyed traffic as tenant None, but the member
+                # still scored its default tenant, and that default
+                # may be a challenger's incumbent
+                scored_by = doc.get("tenant", tenant)
+                if _offer_shadow and "predictions" in doc:
+                    for sh in self._shadows:
+                        if scored_by == sh.incumbent:
+                            sh.offer(key, body, doc["predictions"])
+                return 200, doc
+            except urllib.error.HTTPError as e:
                 try:
-                    with urllib.request.urlopen(
-                        req, timeout=self._timeout
-                    ) as r:
-                        doc = json.load(r)
-                    dt = time.perf_counter() - t0
-                    self._windows[group].observe(dt)
-                    self._group_requests[group].inc()
-                    if tenant is not None:
-                        if tenant in self._known_tenants:
-                            self._tenant_latency.labels(tenant).observe(
-                                dt)
+                    err = json.load(e)
+                except (ValueError, OSError):
+                    err = {"error": f"http {e.code}"}
+                if tctx is not None:
+                    tctx.add_span(
+                        "router.forward", t0, time.perf_counter(),
+                        group=group, attempt=state["attempts"],
+                        status=e.code,
+                    )
+                if e.code == 409:
+                    # generation skew: learn the member's live
+                    # generation FOR THIS TENANT and retry once,
+                    # same group (the 409 carries the tenant whose
+                    # pin went stale — tenant A's swap never
+                    # invalidates B's pins)
+                    self._c_skew.inc()
                     with self._lock:
-                        if "group_generation" in doc:
+                        if "group_generation" in err:
                             self._generation[(group, tenant)] = int(
-                                doc["group_generation"]
+                                err["group_generation"]
                             )
-                    if tctx is not None:
-                        span_attrs = {"group": group, "attempt": attempts,
-                                      "status": 200}
-                        if tenant is not None:
-                            span_attrs["tenant"] = tenant
-                        tctx.add_span(
-                            "router.forward", t0, time.perf_counter(),
-                            **span_attrs,
-                        )
-                    doc["router"] = {"group": group, "attempts": attempts}
-                    if tenant is not None:
-                        doc["router"]["tenant"] = tenant
-                    # shadow the incumbent's answered stream: a
-                    # hash-stable sample is re-scored by each challenger
-                    # off this path (bounded queue, sheds under load);
-                    # the response below is already the incumbent's and
-                    # never waits on it.  Gate on the tenant the member
-                    # REPORTS scoring — a split-less fleet routes
-                    # unkeyed traffic as tenant None, but the member
-                    # still scored its default tenant, and that default
-                    # may be a challenger's incumbent
-                    scored_by = doc.get("tenant", tenant)
-                    if _offer_shadow and "predictions" in doc:
-                        for sh in self._shadows:
-                            if scored_by == sh.incumbent:
-                                sh.offer(key, body, doc["predictions"])
-                    return 200, doc
-                except urllib.error.HTTPError as e:
-                    try:
-                        err = json.load(e)
-                    except (ValueError, OSError):
-                        err = {"error": f"http {e.code}"}
-                    if tctx is not None:
-                        tctx.add_span(
-                            "router.forward", t0, time.perf_counter(),
-                            group=group, attempt=attempts, status=e.code,
-                        )
-                    if e.code == 409:
-                        # generation skew: learn the member's live
-                        # generation FOR THIS TENANT and retry once,
-                        # same group (the 409 carries the tenant whose
-                        # pin went stale — tenant A's swap never
-                        # invalidates B's pins)
-                        self._c_skew.inc()
-                        with self._lock:
-                            if "group_generation" in err:
-                                self._generation[(group, tenant)] = int(
-                                    err["group_generation"]
-                                )
-                        last_err = err
-                        continue
-                    if e.code in (400, 413):
-                        # the client's fault: no retry can fix the body
-                        return e.code, err
-                    last_err = err
-                    if e.code >= 500 and e.code != 503:
-                        # a server-side failure counts toward ejection
-                        # exactly like a connection failure — a member
-                        # whose engine 500s every predict must leave
-                        # rotation at traffic speed.  503 is exempt: it
-                        # is the engine's BACKPRESSURE signal (bounded
-                        # queue shedding), and ejecting an overloaded-
-                        # but-healthy member would amplify the overload
-                        self._eject_on_traffic(group, m, f"http {e.code}")
-                    break  # 5xx/503: next group
-                except Exception as e:
-                    # connection-level failure: count toward ejection so
-                    # a dead member leaves rotation at traffic speed, not
-                    # probe speed
-                    if tctx is not None:
-                        tctx.add_span(
-                            "router.forward", t0, time.perf_counter(),
-                            group=group, attempt=attempts,
-                            status=type(e).__name__,
-                        )
-                    self._eject_on_traffic(group, m, type(e).__name__)
-                    last_err = {"error": f"{type(e).__name__}: {e}"}
-                    break
-                finally:
-                    with self._lock:
-                        m.inflight -= rows
-        return 503, last_err
+                    state["last_err"] = err
+                    continue
+                if e.code in (400, 413):
+                    # the client's fault: no retry can fix the body
+                    return e.code, err
+                if e.code == 504:
+                    # the member ANSWERED: the deadline passed while the
+                    # request sat queued (expiry-at-dequeue).  Not a
+                    # health verdict, and not retryable — the deadline
+                    # is equally gone on every other group
+                    return e.code, err
+                state["last_err"] = err
+                if e.code >= 500 and e.code != 503:
+                    # a server-side failure counts toward ejection
+                    # exactly like a connection failure — a member
+                    # whose engine 500s every predict must leave
+                    # rotation at traffic speed.  503 is exempt: it
+                    # is the engine's BACKPRESSURE signal (bounded
+                    # queue shedding), and ejecting an overloaded-
+                    # but-healthy member would amplify the overload
+                    self._eject_on_traffic(group, m, f"http {e.code}")
+                elif e.code == 503 and self._shed_gate is not None:
+                    # backpressure feeds the shadow shed gate instead
+                    self._shed_gate.note(True)
+                return None  # 5xx/503: next group
+            except Exception as e:
+                # connection-level failure: count toward ejection so
+                # a dead member leaves rotation at traffic speed, not
+                # probe speed
+                if tctx is not None:
+                    tctx.add_span(
+                        "router.forward", t0, time.perf_counter(),
+                        group=group, attempt=state["attempts"],
+                        status=type(e).__name__,
+                    )
+                self._eject_on_traffic(group, m, type(e).__name__)
+                state["last_err"] = {"error": f"{type(e).__name__}: {e}"}
+                return None
+            finally:
+                with self._lock:
+                    m.inflight -= rows
+        return None  # both pin attempts skewed: next group
 
     def _eject_on_traffic(self, group: str, m: _Member, why: str) -> None:
         with self._lock:
@@ -616,6 +830,16 @@ class Router:
                 },
                 "groups": groups,
             }
+        # the SLO control plane's own gauges (each section present only
+        # when that mechanism is attached)
+        if self._retry_budget is not None:
+            out["router"]["retry_budget"] = self._retry_budget.snapshot()
+            out["router"]["retry_budget_exhausted_total"] = int(
+                self._c_budget_exhausted.value)
+        if self._hedge is not None:
+            out["router"]["hedge"] = self._hedge.snapshot()
+        if self._shed_gate is not None:
+            out["router"]["shed_gate"] = self._shed_gate.snapshot()
         # the fleet view: per-tenant split share, routed requests and
         # router-measured latency, plus the shadow challenger's stats
         if self._split is not None or self._shadows:
@@ -711,14 +935,31 @@ def make_router_handler(router: Router):
                 except Exception as e:
                     return self._send(400,
                                       {"error": f"{type(e).__name__}: {e}"})
+                deadline_ms = None
+                hdr = self.headers.get("X-Deadline-Ms")
+                if hdr is not None:
+                    try:
+                        deadline_ms = max(0.0, float(hdr))
+                    except ValueError:
+                        deadline_ms = None
                 code, doc = router.handle_predict(
                     body,
                     path=recommend_path if self.path == recommend_path
                     else None,
                     # explicit tenant selection wins over the split arm
                     tenant=self.headers.get("X-Tenant"),
+                    deadline_ms=deadline_ms,
+                    priority=self.headers.get("X-Priority"),
                 )
-                self._send(code, doc)
+                # admission rejections carry a back-off hint; surface it
+                # as the HTTP Retry-After header the member couldn't set
+                # across the hop
+                extra = None
+                if code == 503 and isinstance(
+                        doc.get("retry_after_s"), (int, float)):
+                    extra = {"Retry-After":
+                             max(1, int(doc["retry_after_s"] + 0.999))}
+                self._send(code, doc, extra_headers=extra)
             finally:
                 router.tracer.finish(ctx, token, status=self._obs_status)
 

@@ -106,17 +106,21 @@ class _TenantDispatch:
     ``tenants_snapshot`` is the ``tenants``-section hook
     (serve/server.py)."""
 
+    # the handler passes X-Deadline-Ms / X-Priority kwargs through to
+    # the tenant engine (serve/server.py _slo_kwargs gates on this)
+    supports_deadline = True
+
     def __init__(self, member: "GroupMember"):
         self._member = member
 
     def _engine(self):
         return self._member._tenant().engine
 
-    def score(self, ids, vals):
-        return self._engine().score(ids, vals)
+    def score(self, ids, vals, **kw):
+        return self._engine().score(ids, vals, **kw)
 
-    def score_instances(self, instances):
-        return self._engine().score_instances(instances)
+    def score_instances(self, instances, **kw):
+        return self._engine().score_instances(instances, **kw)
 
     def metrics_snapshot(self) -> dict:
         return self._member.engine.metrics_snapshot()
@@ -174,6 +178,7 @@ class GroupMember:
         precompile: bool = True,
         registry: MetricsRegistry | None = None,
         tenants=None,
+        slo=None,
     ):
         from ...funnel.publish import is_funnel_servable
         from ...parallel.mesh import mesh_shape
@@ -269,6 +274,27 @@ class GroupMember:
             "deepfm_pool_tenant_events_total",
             "per-tenant member lifecycle events",
             labels=("tenant", "event"))
+        # ONE admission controller across every tenant engine (``slo`` is
+        # a core.config.SloConfig): the tenants share the same bucket
+        # executables and the same devices, so one cost model prices all
+        # of them and one shed ladder answers for the member's queue
+        # pressure.  Funnel members keep their own engine construction —
+        # the SLO control plane covers the CTR predict path.
+        self.admission = None
+        if slo is not None and not self.funnel:
+            from ..control.admission import AdmissionController
+            from ..control.cost import BucketCostModel
+
+            self.admission = AdmissionController(
+                BucketCostModel(buckets),
+                deadline_ms=slo.deadline_ms,
+                shed_shadow_util=slo.shed_shadow_util,
+                degrade_util=slo.degrade_util,
+                shed_predict_util=slo.shed_predict_util,
+                degrade_floor_pct=slo.degrade_floor_pct,
+                name=f"predict[{group}/{member}]",
+                registry=self.registry,
+            )
         if self.funnel:
             ts = _TenantState(specs[0].name, specs[0].source or source)
             ts.holder = holder
@@ -294,6 +320,7 @@ class GroupMember:
                     name=(f"predict[{group}/{member}/{spec.name}]" if multi
                           else f"predict[{group}/{member}]"),
                     registry=self.registry,
+                    admission=self.admission,
                 )
                 self._tenants[ts.name] = ts
         self.engine = self._tenants[self._default].engine
@@ -775,7 +802,7 @@ def make_member_handler(member: GroupMember, model_name: str):
                     self._attrib_tenant = None
             return super().do_POST()
 
-        def _send(self, code, doc):
+        def _send(self, code, doc, extra_headers=None):
             # post-score attribution guard (JSON predict/recommend): the
             # response labels (tenant, generation, model_version) are
             # read at assembly time, AFTER scoring — if this tenant's
@@ -808,7 +835,7 @@ def make_member_handler(member: GroupMember, model_name: str):
                         "tenant": t,
                         "group_generation": live,
                     })
-            return super()._send(code, doc)
+            return super()._send(code, doc, extra_headers=extra_headers)
 
         def _do_predict_selected(self, tenant):
             resolved = tenant or member.selected_tenant()

@@ -43,6 +43,7 @@ import argparse
 import json
 import sys
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
@@ -61,6 +62,10 @@ from .batcher import (
     OverloadedError,
     check_features,
     instances_to_arrays,
+)
+from .control.admission import (
+    DeadlineExpiredError,
+    DeadlineRejectedError,
 )
 
 _check_features = check_features
@@ -340,13 +345,17 @@ class ScoringHTTPServer(ThreadingHTTPServer):
         super().server_bind()
 
 
-def _send_json(self, code: int, payload: dict) -> None:
+def _send_json(self, code: int, payload: dict,
+               extra_headers: dict | None = None) -> None:
     import os
 
     body = json.dumps(payload).encode()
     self.send_response(code)
     self.send_header("Content-Type", "application/json")
     self.send_header("Content-Length", str(len(body)))
+    if extra_headers:
+        for k, v in extra_headers.items():
+            self.send_header(k, str(v))
     # which process answered — lets pool clients/ops attribute responses
     # (and lets the bench warm every SO_REUSEPORT worker deterministically)
     self.send_header("X-Serving-Pid", str(os.getpid()))
@@ -359,6 +368,37 @@ def _send_json(self, code: int, payload: dict) -> None:
     self.wfile.write(body)
     # observed by the tracing wrapper (finish() stamps it as the status)
     self._obs_status = code
+
+
+def _slo_kwargs(headers, scorer) -> dict:
+    """Per-request SLO kwargs for engines that understand them
+    (``supports_deadline`` — the micro-batching engine): the client's
+    ``X-Deadline-Ms`` made ABSOLUTE against this host's clock at parse
+    time, so queue wait counts against it, plus the declared
+    ``X-Priority`` class (shadow | recommend | predict).  Engines
+    without the attribute get neither kwarg — the headers degrade to
+    no-ops, never TypeErrors."""
+    if not getattr(scorer, "supports_deadline", False):
+        return {}
+    kw: dict = {}
+    hdr = headers.get("X-Deadline-Ms")
+    if hdr is not None:
+        try:
+            ms = float(hdr)
+        except ValueError:
+            ms = -1.0
+        if ms >= 0:
+            kw["deadline_s"] = time.perf_counter() + ms / 1e3
+    pri = headers.get("X-Priority")
+    if pri:
+        kw["priority"] = pri.strip().lower()
+    return kw
+
+
+def _retry_after_headers(e: "DeadlineRejectedError") -> dict:
+    # Retry-After is integer seconds on the wire; never advertise 0
+    # (that reads as "retry immediately" — the opposite of the hint)
+    return {"Retry-After": max(1, int(e.retry_after_s + 0.999))}
 
 
 def _send_text(self, code: int, body: str,
@@ -537,9 +577,22 @@ def make_handler(scorer, model_name: str, reload_status=None,
                 self._send(400, {"error": f"{type(e).__name__}: {e}"})
                 return
             try:
-                probs = scorer.score_instances(instances)
+                probs = scorer.score_instances(
+                    instances, **_slo_kwargs(self.headers, scorer))
             except (ValueError, KeyError, TypeError) as e:
                 self._send(400, {"error": f"{type(e).__name__}: {e}"})
+                return
+            except DeadlineRejectedError as e:
+                # admission said no (deadline unmeetable, or the shed
+                # ladder dropped this class) — 503 + back-off hint
+                self._send(503, {"error": str(e),
+                                 "retry_after_s": round(e.retry_after_s, 3)},
+                           extra_headers=_retry_after_headers(e))
+                return
+            except DeadlineExpiredError as e:
+                # admitted, then the deadline passed while queued: the
+                # engine answered at dequeue without scoring — 504
+                self._send(504, {"error": str(e)})
                 return
             except OverloadedError as e:
                 self._send(503, {"error": str(e)})
@@ -595,10 +648,20 @@ def make_handler(scorer, model_name: str, reload_status=None,
                 return
             try:
                 probs = np.ascontiguousarray(
-                    scorer.score(ids, vals), np.float32
+                    scorer.score(ids, vals,
+                                 **_slo_kwargs(self.headers, scorer)),
+                    np.float32,
                 )
             except (ValueError, KeyError, TypeError) as e:
                 self._send(400, {"error": f"{type(e).__name__}: {e}"})
+                return
+            except DeadlineRejectedError as e:
+                self._send(503, {"error": str(e),
+                                 "retry_after_s": round(e.retry_after_s, 3)},
+                           extra_headers=_retry_after_headers(e))
+                return
+            except DeadlineExpiredError as e:
+                self._send(504, {"error": str(e)})
                 return
             except OverloadedError as e:
                 self._send(503, {"error": str(e)})

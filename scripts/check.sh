@@ -26,14 +26,19 @@ fi
 # coordinator outage (frozen-topology training), and a stale-token writer
 # refused on both the commit and publish path (emits
 # docs/BENCH_ELASTIC_MULTIHOST.json via `python bench.py
-# --elastic-multihost`).  Off by default: each drill trains two full runs
-# and serves under load (~minutes), which does not belong in the
-# per-commit static gate.
+# --elastic-multihost`); (3) the OVERLOAD drill
+# (tests/test_control_chaos.py): a FaultPlan latency window stalls one
+# shard-group mid-load — hedges must engage, the stalled group must NOT
+# be ejected, the hedge rate must decay to zero after the heal, and zero
+# admitted requests may fail.  Off by default: each drill trains two
+# full runs and serves under load (~minutes), which does not belong in
+# the per-commit static gate.
 if [[ "${CHECK_SLOW:-0}" == "1" || "${1:-}" == "--slow" || "${2:-}" == "--slow" ]]; then
     env JAX_PLATFORMS=cpu \
         XLA_FLAGS="${XLA_FLAGS:+$XLA_FLAGS }--xla_force_host_platform_device_count=8" \
         python -m pytest tests/test_elastic_chaos.py \
-        tests/test_elastic_multihost.py -q -m slow \
+        tests/test_elastic_multihost.py tests/test_control_chaos.py \
+        -q -m slow \
         -p no:cacheprovider
 fi
 
@@ -74,13 +79,21 @@ fi
 # host-callback custom_calls in the module and lower deterministically
 # across fresh builds (a host-timer value captured by the trace bakes a
 # different constant per retrace).
+# — and the CONTROL-PLANE contract (audit_control_plane): the SLO control
+# plane (deepfm_tpu/serve/control — deadline-aware admission, the shed
+# ladder, hedging, autoscaling) is host-side policy; with the full plane
+# constructed and fed an observation stream, the serving predict must
+# still lower transfer-guard-clean, callback-free and deterministically
+# (an admission decision reading a traced value, or a scale decision
+# smuggled in via io_callback, fails the gate).
 # Seeded violations in tests/test_analysis.py (smuggled transfer,
 # dense-row leak, off-bucket/indivisible shape, baked mixed-generation
 # payload, spec-divergent tenants claiming one executable, baked tenant
 # payload, full-corpus score gather, baked index, reshard host round-trip,
 # baked reshard table, host timer closed over a traced value, registry
-# call inside a jitted fn) prove each contract actually catches its
-# regression.
+# call inside a jitted fn, admission check on a traced queue depth,
+# io_callback scale decision inside jit) prove each contract actually
+# catches its regression.
 exec env JAX_PLATFORMS=cpu \
     XLA_FLAGS="${XLA_FLAGS:+$XLA_FLAGS }--xla_force_host_platform_device_count=8" \
     python -m deepfm_tpu.analysis deepfm_tpu \

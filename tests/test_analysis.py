@@ -1668,3 +1668,96 @@ class TestObservabilityAudit:
             "\n".join(f.render() for f in findings)
         # keep create_train_state imported for the abstract state shape
         assert callable(create_train_state)
+
+
+# ------------------------------------------------------------ control plane
+
+class TestControlPlaneAudit:
+    """audit_control_plane: every SLO decision (admission, hedging,
+    autoscaling) is host-side policy — none of it may enter the lowered
+    serving graph.  The real predict passes with a live, fed control
+    plane; each seeded violation is a way a well-meaning adaptive-serving
+    patch could fuse a decision INTO the executables."""
+
+    def test_real_predict_holds_under_live_control_plane(self):
+        from deepfm_tpu.analysis.trace_audit import audit_control_plane
+
+        findings = audit_control_plane()
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_seeded_admission_on_traced_value_caught(self):
+        """An admission decision that reads a TRACED value (pricing the
+        request against the model's own output) concretizes under the
+        transfer guard — the audit reports the lowering failure as a
+        finding instead of crashing."""
+        import jax
+
+        from deepfm_tpu.analysis.trace_audit import audit_control_plane
+        from deepfm_tpu.serve.control.admission import AdmissionController
+        from deepfm_tpu.serve.control.cost import BucketCostModel
+
+        adm = AdmissionController(
+            BucketCostModel((8, 32)), deadline_ms=50.0)
+        adm.cost.observe(8, 0.001)
+
+        def bad_builder(model, cfg):
+            @jax.jit
+            def predict_with(payload, feat_ids, feat_vals):
+                logits, _ = model.apply(
+                    payload["params"], payload["model_state"],
+                    feat_ids, feat_vals, cfg=cfg.model, train=False,
+                )
+                out = jax.nn.sigmoid(logits)
+                # the queue-depth input to the admission decision is a
+                # traced value — int() concretizes it at trace time
+                adm.check(rows=8, queued_rows=int(out[0] * 1000),
+                          max_queue_rows=4096, deadline_s=None)
+                return out
+
+            return predict_with
+
+        findings = audit_control_plane(predict_builder=bad_builder)
+        assert any(f.rule == "trace-control-plane"
+                   and "admission or scale decision" in f.message
+                   for f in findings), \
+            "\n".join(f.render() for f in findings)
+
+    def test_seeded_scale_decision_in_jit_caught(self):
+        """A scale decision smuggled into the graph via io_callback
+        lowers as a host-callback custom_call — convicted by the
+        callback scan."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.experimental import io_callback
+
+        from deepfm_tpu.analysis.trace_audit import audit_control_plane
+        from deepfm_tpu.serve.control.autoscale import AutoScaler
+
+        scaler = AutoScaler(min_groups=1, max_groups=4)
+
+        def _decide(v):
+            scaler.observe(0.0, groups=1, util=float(v))
+            return np.float32(0.0)
+
+        def bad_builder(model, cfg):
+            @jax.jit
+            def predict_with(payload, feat_ids, feat_vals):
+                logits, _ = model.apply(
+                    payload["params"], payload["model_state"],
+                    feat_ids, feat_vals, cfg=cfg.model, train=False,
+                )
+                out = jax.nn.sigmoid(logits)
+                # the autoscale decision rides the dispatch
+                zero = io_callback(
+                    _decide, jax.ShapeDtypeStruct((), jnp.float32),
+                    out[0],
+                )
+                return out + zero
+
+            return predict_with
+
+        findings = audit_control_plane(predict_builder=bad_builder)
+        assert any(f.rule == "trace-control-plane"
+                   and "host callback" in f.message for f in findings), \
+            "\n".join(f.render() for f in findings)

@@ -1,0 +1,163 @@
+"""The overload chaos drill (slow-marked): one shard-group stalls
+mid-load (a FaultPlan latency window on its predict path — the same
+chaos layer the store drills use), and the SLO control plane must ride
+it out with GRACEFUL degradation, not a topology change:
+
+* hedges engage — the stalled group's live p95 breaches the SLO budget,
+  so requests race a delayed hedge to the next candidate and the fast
+  group's answer wins;
+* the stalled group is NEVER ejected — slow-but-answering is
+  backpressure territory, and ejecting it would amplify the overload;
+* after the stall heals, the hedge rate decays to zero — primaries
+  answer inside the hedge delay again, so no hedge ever fires;
+* zero admitted-then-failed requests: every client call in every phase
+  is answered 200 (the invariant the whole control plane is built on —
+  shed at the door if you must, never fail work you admitted).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from deepfm_tpu.serve.control.hedge import HedgeController, TokenBudget
+from deepfm_tpu.serve.pool.router import Router
+from deepfm_tpu.utils.dev_object_store import FaultPlan
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+
+class _SloMember:
+    """Healthy stub member whose POST path is FaultPlan-scriptable:
+    ``plan.add(verb="POST", key="v1/models/*", delay_secs=...)`` is the
+    stall injection; clearing the rules is the heal."""
+
+    def __init__(self, group, *, plan=None):
+        self.group = group
+        self.plan = plan if plan is not None else FaultPlan()
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _send(self, code, doc):
+                body = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    return self._send(200, {"status": "alive"})
+                if self.path == "/readyz":
+                    return self._send(200, {"ready": True,
+                                            "shard_group": stub.group,
+                                            "group_generation": 0})
+                return self._send(404, {"error": "nope"})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                rule = stub.plan.match("POST", self.path.lstrip("/"))
+                if rule is not None:
+                    if rule.delay_secs > 0:
+                        time.sleep(rule.delay_secs)
+                    if rule.status:
+                        return self._send(rule.status,
+                                          {"error": "injected fault"})
+                n = len(body.get("instances", []))
+                return self._send(200, {
+                    "predictions": [0.5] * n,
+                    "model_version": 1,
+                    "shard_group": stub.group,
+                    "group_generation": 0,
+                })
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_group_stall_hedges_through_then_decays_to_zero():
+    a, b = _SloMember("g0"), _SloMember("g1")
+    hedge = HedgeController(
+        slo_budget_ms=80.0, after_pct=50.0,
+        budget=TokenBudget(1.0, burst=64.0),
+    )
+    # spread=1 pins each key to its ring-order primary (no least-loaded
+    # re-rank) so the drill's traffic deterministically fronts g0
+    router = Router(
+        {"g0": [a.url], "g1": [b.url]},
+        retry_limit=1, spread=1, probe_interval_secs=30,
+        request_timeout_secs=10, hedge=hedge,
+    )
+    try:
+        router.probe_once()
+        key = next(
+            k for k in (f"k{i}" for i in range(200))
+            if router._ring.candidates(k)[0] == "g0"
+        )
+        body = {"key": key,
+                "instances": [{"feat_ids": [0], "feat_vals": [0.0]}]}
+        failed = 0
+
+        def drive(n):
+            nonlocal failed
+            tags = []
+            for _ in range(n):
+                code, doc = router.handle_predict(dict(body))
+                if code != 200:
+                    failed += 1
+                tags.append(doc.get("router", {}).get("hedge"))
+            return tags
+
+        # -- phase 1: healthy pool — no hedge state, no extra load
+        drive(20)
+        assert hedge.fired_total == 0
+
+        # -- phase 2: g0 stalls (250 ms on every predict).  The live p95
+        # crosses the 80 ms SLO budget within a few samples; from then on
+        # every request races a ~125 ms hedge against the 250 ms primary
+        # and the fast group answers first.
+        a.plan.add(verb="POST", key="v1/models/*", delay_secs=0.25)
+        stall_tags = drive(12)
+        assert hedge.fired_total > 0
+        assert hedge.wins_total > 0
+        assert "hedge" in stall_tags  # fast-group answers actually served
+        # slow-but-answering is NOT a health verdict: no ejection, the
+        # stalled group stays in rotation for its eventual recovery
+        assert router.ejections_total == 0
+
+        # -- phase 3: heal.  Primaries answer inside the hedge delay
+        # again, so the race resolves before the hedge arms: the hedge
+        # rate decays to zero immediately, with no operator action.
+        a.plan.set_rules([])
+        fired_at_heal = hedge.fired_total
+        heal_tags = drive(40)
+        assert hedge.fired_total == fired_at_heal
+        assert all(t is None for t in heal_tags)
+
+        # -- the drill's bottom line: graceful degradation end to end
+        assert failed == 0, "an admitted request failed during the drill"
+        snap = router.metrics_snapshot()
+        assert snap["groups"]["g0"]["healthy_members"] == 1
+        assert snap["router"]["hedge"]["fired_total"] == fired_at_heal
+        assert snap["router"]["hedge"]["wins_total"] >= 1
+    finally:
+        router.close()
+        a.close()
+        b.close()

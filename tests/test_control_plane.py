@@ -1,0 +1,433 @@
+"""SLO control plane (deepfm_tpu/serve/control): the per-bucket cost
+model, deadline-aware admission + the priority shed ladder, the
+expired-at-dequeue 504 path (a full bucket of stale work dispatches
+NOTHING), the shared retry/hedge token budget, hedging policy, autoscale
+hysteresis — and the brownout regression: with the budget attached, a
+2-group pool answering nothing but 503s sees SUB-LINEAR request
+amplification (fail-fast beats retry-multiplying the offered load)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from deepfm_tpu.serve.batcher import MicroBatcher
+from deepfm_tpu.serve.control.admission import (
+    AdmissionController,
+    DeadlineExpiredError,
+    DeadlineRejectedError,
+    LoadShedGate,
+    ShedError,
+)
+from deepfm_tpu.serve.control.autoscale import AutoScaler
+from deepfm_tpu.serve.control.cost import BucketCostModel
+from deepfm_tpu.serve.control.hedge import HedgeController, TokenBudget
+from deepfm_tpu.serve.pool.router import Router, start_router
+
+# --------------------------------------------------------------------------
+# cost model
+
+
+def test_cost_model_cold_answers_none_not_a_guess():
+    m = BucketCostModel((8, 32))
+    assert m.dispatch_estimate_s(4) is None
+    assert m.drain_estimate_s(0) == 0.0
+    assert m.drain_estimate_s(40) is None  # cold: no made-up drain price
+
+
+def test_cost_model_ewma_and_nearest_bucket_backstop():
+    m = BucketCostModel((8, 32), alpha=0.2)
+    m.observe(8, 0.010)
+    assert m.dispatch_estimate_s(4) == pytest.approx(0.010)
+    # the unobserved 32-bucket is backstopped by the observed 8-bucket's
+    # per-row rate (cold-start honesty stops at "no bucket at all")
+    assert m.dispatch_estimate_s(20) == pytest.approx(0.010 * 32 / 8)
+    m.observe(8, 0.020)
+    assert m.dispatch_estimate_s(8) == pytest.approx(
+        0.010 + 0.2 * (0.020 - 0.010)
+    )
+    assert m.snapshot()["observations_total"] == 2
+
+
+def test_cost_model_prices_drain_as_largest_bucket_dispatches():
+    m = BucketCostModel((8, 32), alpha=1.0)
+    m.observe(32, 0.100)
+    m.observe(8, 0.030)
+    # 70 queued rows = 2 full 32-row dispatches + one 6-row (8-bucket)
+    assert m.drain_estimate_s(70) == pytest.approx(2 * 0.100 + 0.030)
+
+
+# --------------------------------------------------------------------------
+# admission: the shed ladder + deadline pricing
+
+
+def _adm(**kw):
+    kw.setdefault("util_alpha", 1.0)  # ewma == the raw sample: exact levels
+    return AdmissionController(BucketCostModel((8,)), **kw)
+
+
+def test_shed_ladder_engages_in_declared_order_and_releases_with_hysteresis():
+    adm = _adm()
+
+    def util(u):
+        return dict(rows=1, queued_rows=int(u * 1000), max_queue_rows=1000,
+                    deadline_s=None)
+
+    # level 0: everything admitted, shadow included
+    assert adm.check(**util(0.50), priority="shadow") is None
+    # level 1 (>0.60): shadow sheds FIRST; predicts sail through
+    with pytest.raises(ShedError):
+        adm.check(**util(0.65), priority="shadow")
+    assert adm.check(**util(0.65)) is None
+    assert adm.level() == 1 and adm.degrade_factor() == 1.0
+    # level 2 (>0.75): recommend width degrades to the floor
+    assert adm.check(**util(0.80)) is None
+    assert adm.level() == 2 and adm.degrade_factor() == pytest.approx(0.5)
+    # level 3 (>0.90): plain predicts shed too — 503 + Retry-After
+    with pytest.raises(ShedError) as ei:
+        adm.check(**util(0.95))
+    assert ei.value.retry_after_s > 0
+    # release is hysteretic: 0.70 clears level 3's release bar
+    # (0.85*0.90) but NOT level 2's (0.85*0.75) — one step down, no chatter
+    assert adm.check(**util(0.70)) is None
+    assert adm.level() == 2
+    # deep slack releases the whole ladder
+    assert adm.check(**util(0.10), priority="shadow") is None
+    assert adm.level() == 0
+    sheds = adm.snapshot()["sheds_total"]
+    assert sheds["shadow"] == 1 and sheds["predict"] == 1
+
+
+def test_deadline_unmeetable_rejected_at_admission_with_retry_after():
+    adm = _adm(util_alpha=0.001)  # ladder stays quiet: deadline math only
+    adm.cost.observe(8, 0.050)
+    now = 1000.0
+    # 40 queued rows = 5 full 8-row dispatches (250 ms drain) + own 50 ms;
+    # a 100 ms deadline cannot be met -> rejected at the door
+    with pytest.raises(DeadlineRejectedError) as ei:
+        adm.check(rows=8, queued_rows=40, max_queue_rows=100000,
+                  deadline_s=now + 0.100, now=now)
+    assert ei.value.retry_after_s >= 0.199  # >= late_by (200 ms here)
+    assert adm.snapshot()["deadline_rejected_total"] == 1
+    # the same queue with a roomy deadline admits, answering the
+    # effective absolute deadline for the queue stamp
+    assert adm.check(rows=8, queued_rows=40, max_queue_rows=100000,
+                     deadline_s=now + 10.0, now=now) == now + 10.0
+
+
+def test_inflight_dispatch_remaining_time_is_priced_into_the_deadline():
+    adm = _adm(util_alpha=0.001)
+    adm.cost.observe(8, 0.100)
+    now = 1000.0
+    # empty queue, own dispatch 100 ms, 150 ms deadline: admits when the
+    # worker is idle...
+    kw = dict(rows=8, queued_rows=0, max_queue_rows=100000,
+              deadline_s=now + 0.150, now=now)
+    assert adm.check(**kw) == now + 0.150
+    # ...but an 8-row dispatch that started 20 ms ago still has ~80 ms
+    # to run, and the arrival waits behind it: 80 + 100 > 150 -> rejected
+    # (the blind spot that lets the member run one full bucket late)
+    with pytest.raises(DeadlineRejectedError):
+        adm.check(**kw, inflight=(8, now - 0.020))
+    # a nearly-finished dispatch (95 ms ago) claims only ~5 ms: admits
+    assert adm.check(**kw, inflight=(8, now - 0.095)) == now + 0.150
+
+
+def test_cold_cost_model_admits_and_config_default_deadline_applies():
+    adm = AdmissionController(BucketCostModel((8,)), deadline_ms=250.0)
+    # cold model: unknown cost is admissible (a guess would shed real
+    # traffic on every restart), and the config default becomes the
+    # request's absolute deadline
+    assert adm.check(rows=8, queued_rows=4000, max_queue_rows=100000,
+                     deadline_s=None, now=5.0) == pytest.approx(5.25)
+
+
+# --------------------------------------------------------------------------
+# the 504 path: expiry-at-dequeue backfills, never dispatches
+
+
+def test_full_bucket_of_expired_entries_dispatches_nothing():
+    calls = []
+
+    def fn(ids, vals):
+        calls.append(ids.shape[0])
+        return np.zeros((ids.shape[0],), np.float32)
+
+    mb = MicroBatcher(fn, 4, buckets=(8,), max_wait_ms=1.0)
+    try:
+        stale = time.perf_counter() - 1.0  # expired before it ever queued
+        errs = []
+
+        def submit():
+            try:
+                mb.score(np.zeros((1, 4), np.int64),
+                         np.zeros((1, 4), np.float32), deadline_s=stale)
+            except Exception as e:
+                errs.append(e)
+
+        threads = [threading.Thread(target=submit) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        # every caller got the 504-shaped answer at dequeue...
+        assert len(errs) == 8
+        assert all(isinstance(e, DeadlineExpiredError) for e in errs)
+        # ...and the full bucket of stale work cost ZERO dispatches
+        assert calls == []
+        snap = mb.metrics_snapshot()
+        assert snap["expired_total"] == 8
+        assert snap["dispatches_total"] == 0
+        assert snap["queue_rows"] == 0
+    finally:
+        mb.close()
+
+
+def test_expired_slots_backfill_live_work():
+    calls = []
+
+    def fn(ids, vals):
+        calls.append(ids.shape[0])
+        return np.arange(ids.shape[0], dtype=np.float32)
+
+    mb = MicroBatcher(fn, 4, buckets=(8,), max_wait_ms=20.0)
+    try:
+        stale = time.perf_counter() - 1.0
+        errs, out = [], []
+
+        def submit_stale():
+            try:
+                mb.score(np.zeros((1, 4), np.int64),
+                         np.zeros((1, 4), np.float32), deadline_s=stale)
+            except Exception as e:
+                errs.append(e)
+
+        def submit_live():
+            out.append(mb.score(np.zeros((2, 4), np.int64),
+                                np.zeros((2, 4), np.float32)))
+
+        threads = [threading.Thread(target=submit_stale) for _ in range(7)]
+        threads.append(threading.Thread(target=submit_live))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        # the live request was answered (its slots backfilled past the
+        # stale chunks), the stale ones 504'd, and no dispatch ever
+        # carried an expired row
+        assert len(errs) == 7
+        assert all(isinstance(e, DeadlineExpiredError) for e in errs)
+        assert len(out) == 1 and out[0].shape == (2,)
+        assert sum(calls) >= 1
+        assert mb.metrics_snapshot()["expired_total"] == 7
+    finally:
+        mb.close()
+
+
+# --------------------------------------------------------------------------
+# token budget + hedge policy
+
+
+def test_token_budget_accrues_with_traffic_and_fails_fast_empty():
+    b = TokenBudget(0.25, burst=2.0, initial=0.0)
+    assert not b.try_spend()
+    assert b.exhausted_total == 1
+    for _ in range(4):
+        b.note_request()        # 4 requests * 0.25 = one token
+    assert b.try_spend()
+    assert not b.try_spend()
+    for _ in range(1000):
+        b.note_request()        # accrual is capped at the burst...
+    assert b.try_spend() and b.try_spend()
+    assert not b.try_spend()    # ...so at most `burst` spends in a row
+    assert b.snapshot()["spent_total"] == 3
+
+
+def test_hedge_plans_only_over_slo_and_respects_budget():
+    h = HedgeController(slo_budget_ms=100.0, after_pct=50.0,
+                        budget=TokenBudget(1.0, burst=1.0, initial=1.0))
+    assert h.plan(None) is None        # no signal: no hedge state at all
+    assert h.plan(80.0) is None        # p95 inside the SLO budget
+    assert h.plan(200.0) == pytest.approx(0.100)  # 50% of the live p95
+    assert h.try_fire()
+    assert not h.try_fire()            # budget empty: suppressed, counted
+    h.record_outcome(hedge_won=True)
+    snap = h.snapshot()
+    assert snap["fired_total"] == 1
+    assert snap["suppressed_budget_total"] == 1
+    assert snap["wins_total"] == 1 and snap["cancelled_total"] == 1
+
+
+def test_load_shed_gate_hysteresis():
+    gate = LoadShedGate(threshold=0.3, alpha=0.5)
+    assert gate.allow_shadow()
+    gate.note(True)                    # ewma 0.5 > 0.3: shedding
+    assert not gate.allow_shadow()
+    gate.note(False)                   # 0.25: still above the 0.15 release
+    assert not gate.allow_shadow()
+    gate.note(False)                   # 0.125 < 0.15: released
+    assert gate.allow_shadow()
+
+
+# --------------------------------------------------------------------------
+# autoscale hysteresis (pure policy, injected clock)
+
+
+def test_autoscaler_sustained_breach_cooldown_and_convergence():
+    sc = AutoScaler(min_groups=1, max_groups=3, up_util=0.75,
+                    down_util=0.25, up_window_secs=5.0,
+                    down_window_secs=30.0, cooldown_secs=10.0)
+    # one burst does not buy a group; a reset restarts the window
+    assert sc.observe(0.0, groups=1, util=0.9) is None
+    assert sc.observe(3.0, groups=1, util=0.9) is None
+    assert sc.observe(4.0, groups=1, util=0.1) is None   # breach broken
+    assert sc.observe(6.0, groups=1, util=0.9) is None
+    assert sc.observe(11.5, groups=1, util=0.9) == "up"  # 5.5 s sustained
+    sc.note_scaled(11.5)
+    # the breach window accumulates THROUGH the cooldown: a breach that
+    # spans it acts the moment the refractory period ends
+    assert sc.observe(12.0, groups=2, util=0.9) is None  # cooling down
+    assert sc.observe(22.0, groups=2, util=0.9) == "up"
+    sc.note_scaled(22.0)
+    # bounded above
+    assert sc.observe(40.0, groups=3, util=0.9) is None
+    # slack must persist far longer before capacity is released
+    assert sc.observe(50.0, groups=3, util=0.1) is None
+    assert sc.observe(79.0, groups=3, util=0.1) is None  # 29 s < 30 s
+    assert sc.observe(81.0, groups=3, util=0.1) == "down"
+    sc.note_scaled(81.0)
+    assert sc.observe(92.0, groups=2, util=0.1) is None  # window restarts
+    assert sc.observe(123.0, groups=2, util=0.1) == "down"
+    sc.note_scaled(123.0)
+    # converged back: never below min_groups, however long the slack
+    assert sc.observe(500.0, groups=1, util=0.0) is None
+    assert sc.scale_ups_total == 2 and sc.scale_downs_total == 2
+
+
+def test_autoscaler_p95_breach_counts_even_at_low_utilization():
+    sc = AutoScaler(min_groups=1, max_groups=2, slo_ms=100.0,
+                    up_window_secs=5.0, cooldown_secs=1.0)
+    # a tail-latency SLO breach is a breach — utilization alone would
+    # sleep through a paging stall
+    assert sc.observe(0.0, groups=1, util=0.1, p95_ms=400.0) is None
+    assert sc.observe(6.0, groups=1, util=0.1, p95_ms=400.0) == "up"
+    # and p95 over SLO vetoes "slack": no scale-down while breaching
+    sc.note_scaled(6.0)
+    assert sc.observe(50.0, groups=2, util=0.1, p95_ms=400.0) is None
+    assert sc.snapshot()["in_slack"] is False
+
+
+# --------------------------------------------------------------------------
+# the brownout regression: sub-linear amplification under pool-wide 503s
+
+
+class _BrownoutMember:
+    """A member in permanent backpressure: healthy/ready, answers every
+    predict 503 (the engine's bounded-queue shed) and counts the hits."""
+
+    def __init__(self):
+        self.hits = 0
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _send(self, code, doc):
+                body = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    return self._send(200, {"status": "alive"})
+                if self.path == "/readyz":
+                    return self._send(200, {"ready": True,
+                                            "group_generation": 0})
+                return self._send(404, {"error": "nope"})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                self.rfile.read(length)
+                stub.hits += 1
+                return self._send(503, {"error": "scoring queue full"})
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _post_status(url, payload, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.load(r), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e), dict(e.headers)
+
+
+def test_brownout_retry_budget_keeps_amplification_sublinear():
+    """Both groups 503 every predict.  Un-budgeted, retry_limit=1 doubles
+    the offered load (every request fans to both groups) exactly when
+    capacity is scarcest; the shared token budget caps the retries at
+    ~10% of the request rate and fails the rest FAST with Retry-After."""
+    a, b = _BrownoutMember(), _BrownoutMember()
+    httpd, base, router = start_router(
+        {"g0": [a.url], "g1": [b.url]},
+        retry_limit=1, probe_interval_secs=30,
+        retry_budget=TokenBudget(0.1, burst=1.0, initial=0.0),
+    )
+    try:
+        n = 60
+        fail_fast = 0
+        for i in range(n):
+            code, doc, headers = _post_status(
+                f"{base}/v1/models/deepfm:predict",
+                {"key": f"k{i}", "instances": [
+                    {"feat_ids": [0], "feat_vals": [0.0]}]},
+            )
+            # no admitted-then-failed ambiguity here: the pool is
+            # saturated and every answer is an honest 503
+            assert code == 503
+            if "retry budget exhausted" in doc.get("error", ""):
+                fail_fast += 1
+                # the fail-fast path carries the back-off hint end-to-end
+                assert doc["retry_after_s"] == pytest.approx(1.0)
+                assert headers.get("Retry-After") == "1"
+        hits = a.hits + b.hits
+        # sub-linear amplification: the members saw the n primaries plus
+        # at most the budget's accrual (0.1*n) and burst — nowhere near
+        # the 2x fan-out an un-budgeted retry policy produces
+        assert hits >= n
+        assert hits <= n + int(0.1 * n) + 2, (a.hits, b.hits)
+        assert fail_fast > 0
+        snap = router.metrics_snapshot()
+        assert snap["router"]["retry_budget_exhausted_total"] == fail_fast
+        assert snap["router"]["retry_budget"]["spent_total"] == hits - n
+        # backpressure is NOT a health verdict: nobody got ejected
+        assert snap["router"]["ejections_total"] == 0
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        router.close()
+        a.close()
+        b.close()

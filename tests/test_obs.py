@@ -180,11 +180,13 @@ class TestPinnedSchemas:
             snap = mb.metrics_snapshot()
         finally:
             mb.close()
+        # "expired_total" joined the pin with the deadline-admission work
+        # (PR 14): expiry-at-dequeue is a first-class engine outcome
         assert set(snap) == {
             "engine", "name", "buckets", "max_wait_ms", "max_queue_rows",
             "queue_rows", "queue_requests", "requests_total", "rows_total",
             "dispatches_total", "padded_rows_total", "rejected_total",
-            "batch_size_hist", "latency_ms",
+            "expired_total", "batch_size_hist", "latency_ms",
         }
         assert snap["engine"] == "micro_batcher"
         assert set(snap["batch_size_hist"]) == {"4", "8"}

@@ -1040,3 +1040,38 @@ def test_worker_prometheus_and_flight_surfaces(pool_env):
         rh.shutdown()
         h.shutdown()
         m.close()
+
+
+def test_member_deadline_rejection_is_a_503_with_retry_after(pool_env):
+    """An X-Deadline-Ms the member's cost model cannot meet must come
+    back as a well-formed 503 + ``Retry-After`` — NOT a dropped
+    connection.  Regression: the member handler's ``_send`` override
+    (post-score attribution guard) lacked the ``extra_headers``
+    pass-through the base handler uses for the Retry-After hint, so the
+    rejection path raised mid-response and the socket just closed."""
+    from deepfm_tpu.core.config import SloConfig
+    from deepfm_tpu.serve.pool.sharded import build_serve_mesh
+    from deepfm_tpu.serve.pool.worker import start_member
+
+    h, u, m = start_member(
+        pool_env["servable"], build_serve_mesh(1, 2, group_index=0),
+        group="gd", buckets=(4, 8), max_wait_ms=1.0,
+        exchange="alltoall", slo=SloConfig(deadline_ms=250.0),
+    )
+    try:
+        # warm the admission cost model: one scored dispatch gives the
+        # per-bucket EWMA something to price the next request with
+        _post(f"{u}/v1/models/deepfm:predict", {"instances": _instances(2)})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(f"{u}/v1/models/deepfm:predict",
+                  {"instances": _instances(2)},
+                  headers={"X-Deadline-Ms": "0.001"})
+        err = ei.value
+        assert err.code == 503
+        assert int(err.headers["Retry-After"]) >= 1
+        doc = json.load(err)
+        assert "deadline" in doc["error"]
+        assert doc["retry_after_s"] > 0
+    finally:
+        h.shutdown()
+        m.close()
