@@ -97,6 +97,7 @@ class PoolProcess:
         buckets: str = "4,8",
         health_interval: float = 0.2,
         env: dict | None = None,
+        extra_argv: tuple = (),
     ):
         import os
 
@@ -116,7 +117,8 @@ class PoolProcess:
              "--buckets", buckets,
              "--health-interval", str(health_interval),
              "--reload-url", reload_url,
-             "--reload-interval", str(reload_interval)],
+             "--reload-interval", str(reload_interval),
+             *extra_argv],
             env=run_env, stderr=subprocess.DEVNULL,
         )
 

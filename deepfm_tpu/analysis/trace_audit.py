@@ -1718,6 +1718,58 @@ def audit_observability(cfg=None, predict_builder=None,
         ))
     else:
         out.extend(_check_obs_lowering("train_step", texts, where))
+    # -- flywheel impression logger -----------------------------------------
+    # The data flywheel's logger (deepfm_tpu/flywheel/impressions.py)
+    # rides the router's HOST response path: a hash-stable sample of
+    # answered requests is enqueued AFTER the response doc is formed
+    # (serve/pool/router.py _try_group), and a background thread writes
+    # the segments.  Hold the serving predict to the same lowering
+    # contract with a LIVE logger — worker thread running, one scored
+    # offer absorbed — so a logger call that migrates inside the jitted
+    # predict (a score offered under trace, an io_callback into the
+    # writer) fails the audit instead of syncing every dispatch.  The
+    # seeded violation feeds a ``predict_builder`` that offers the
+    # traced score to the logger (tests/test_analysis.py).
+    import tempfile
+
+    from ..flywheel.impressions import ImpressionLogger
+
+    where_fw = "deepfm_tpu/flywheel/impressions.py"
+    texts = []
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            logger = ImpressionLogger(td, sample_rate=1.0).start()
+            try:
+                logger.offer(
+                    key="audit", trace_id="audit-trace", tenant="base",
+                    model_version=0,
+                    instances=[{"feat_ids": [0] * f,
+                                "feat_vals": [0.0] * f}],
+                    scores=[0.5], deadline_class="default")
+                logger.flush()
+                with jax.transfer_guard("disallow"):
+                    for _ in range(2):
+                        texts.append(
+                            build_p(model, cfg)
+                            .lower(payload, *args).as_text()
+                        )
+            finally:
+                logger.stop()
+    except Exception as e:
+        out.append(_finding(
+            "trace-observability",
+            f"lowering the serving predict with a live flywheel "
+            f"impression logger raised {type(e).__name__}: {e} — a "
+            f"logger call closed over a traced value (concretization "
+            f"or implicit transfer under the guard)",
+            hint="offer impressions on the host AFTER the response doc "
+                 "is formed (serve/pool/router.py _try_group); the "
+                 "jitted predict must stay logger-free",
+            where=where_fw, slug="obs-flywheel-lower",
+        ))
+    else:
+        out.extend(
+            _check_obs_lowering("flywheel_predict", texts, where_fw))
     return out
 
 

@@ -690,16 +690,101 @@ class SloConfig:
 
 
 @dataclass(frozen=True)
+class FlywheelConfig:
+    """Data flywheel (``deepfm_tpu/flywheel``): serve → log → join →
+    train on our own traffic.  The serving pool logs a hash-stable
+    sample of scored impressions; a standalone join process matches
+    clicks inside an attribution window (negatives synthesized at
+    expiry); ``task_type=feedback-train`` points the online trainer at
+    the joined stream."""
+
+    # arm the router-side impression logger (task_type=serve pool)
+    enabled: bool = False
+    # immutable-segment log roots (dirs or object URLs, stream.py)
+    impression_log_url: str = ""
+    # click events produced by the application (join input)
+    click_log_url: str = ""
+    # joined labeled stream (join output; feedback-train's input)
+    join_output_url: str = ""
+    # fraction of requests logged, hash-stable per impression id (the
+    # trace id, else the routing key) — the join recomputes the same
+    # decision, so clicks for sampled-out impressions are never orphans
+    sample_rate: float = 1.0
+    # how long after an impression's segment publish a click may still
+    # attribute; expiry under the click watermark synthesizes a negative
+    attribution_window_secs: float = 1800.0
+    # impression-logger segment roll: publish when the buffered segment
+    # reaches this many bytes, or when its oldest record has waited this
+    # long (online/stream.py SegmentWriter)
+    segment_roll_bytes: int = 1 << 20
+    segment_roll_age_secs: float = 10.0
+    # join durability cadence: flush output + commit {cursors, pending}
+    # after this many consumed input segments (checkpoints also land at
+    # every run() exit)
+    join_checkpoint_every_segments: int = 8
+    # bounded logger queue between the serve path and the writer thread;
+    # a full queue drops the impression (counted), never blocks serving
+    queue_depth: int = 1024
+
+    def __post_init__(self):
+        import math
+
+        if not (0.0 < self.sample_rate <= 1.0
+                and math.isfinite(self.sample_rate)):
+            raise ValueError(
+                f"flywheel.sample_rate must be in (0, 1], got "
+                f"{self.sample_rate}"
+            )
+        if not (self.attribution_window_secs > 0
+                and math.isfinite(self.attribution_window_secs)):
+            raise ValueError(
+                f"flywheel.attribution_window_secs must be finite and "
+                f"> 0, got {self.attribution_window_secs}"
+            )
+        if self.segment_roll_bytes < 1:
+            raise ValueError(
+                f"flywheel.segment_roll_bytes must be >= 1, got "
+                f"{self.segment_roll_bytes}"
+            )
+        if not (self.segment_roll_age_secs > 0
+                and math.isfinite(self.segment_roll_age_secs)):
+            raise ValueError(
+                f"flywheel.segment_roll_age_secs must be finite and > 0, "
+                f"got {self.segment_roll_age_secs} — an age-less roll "
+                f"strands a trickle of impressions in the writer buffer"
+            )
+        if self.join_checkpoint_every_segments < 1:
+            raise ValueError(
+                f"flywheel.join_checkpoint_every_segments must be >= 1, "
+                f"got {self.join_checkpoint_every_segments}"
+            )
+        if self.queue_depth < 1:
+            raise ValueError(
+                f"flywheel.queue_depth must be >= 1, got "
+                f"{self.queue_depth}"
+            )
+        if self.enabled and not self.impression_log_url:
+            raise ValueError(
+                "flywheel.enabled needs flywheel.impression_log_url — "
+                "the logger has nowhere to publish segments"
+            )
+
+
+@dataclass(frozen=True)
 class RunConfig:
     """Run/driver config: task dispatch + paths (ps:70-79) + cluster identity
     (SM_HOSTS/SM_CURRENT_HOST analogs, ps:80-95)."""
 
     task_type: str = "train"          # train | eval | infer | export | serve
-                                      # | online-train (ps:77-79; serve =
-                                      # online scoring over the exported
-                                      # servable, serve/server.py; online-
-                                      # train = continuous training from an
-                                      # event log, online/trainer.py)
+                                      # | online-train | feedback-train
+                                      # (ps:77-79; serve = online scoring
+                                      # over the exported servable,
+                                      # serve/server.py; online-train =
+                                      # continuous training from an event
+                                      # log, online/trainer.py; feedback-
+                                      # train = online-train over the
+                                      # flywheel's joined stream,
+                                      # deepfm_tpu/flywheel)
     model_dir: str = "./model_dir"
     servable_model_dir: str = "./servable"
     clear_existing_model: bool = False  # hvd:66-68
@@ -805,6 +890,7 @@ class Config:
     elastic: ElasticConfig = field(default_factory=ElasticConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
     slo: SloConfig = field(default_factory=SloConfig)
+    flywheel: FlywheelConfig = field(default_factory=FlywheelConfig)
 
     def __post_init__(self):
         """Cross-section contracts no single section can check.
@@ -974,6 +1060,34 @@ class Config:
                     f"(EXECUTABLE_SPEC_FIELDS) — serve a divergent spec "
                     f"from its own pool instead"
                 )
+        # 6. data flywheel cross-section contracts: feedback-train is the
+        # online trainer pointed at the JOIN's output — without a join
+        # output URL there is nothing to cursor over; and when a shadow
+        # challenger is armed alongside impression logging, mismatched
+        # sampling rates mean the offline join replays a different slice
+        # of traffic than shadow scoring measured — legal, but the two
+        # reads are then not comparable, so say so once at config time.
+        fw = self.flywheel
+        if r.task_type in ("feedback-train", "feedback_train") \
+                and not fw.join_output_url:
+            raise ValueError(
+                "task_type=feedback-train needs flywheel.join_output_url "
+                "— the joined labeled stream the online trainer tails "
+                "(run `python -m deepfm_tpu.flywheel.join` to produce it)"
+            )
+        if fw.enabled and any(
+                t.get("shadow_of") for t in self.fleet.tenants):
+            shadow_rate = self.fleet.shadow_sample_percent / 100.0
+            if abs(shadow_rate - fw.sample_rate) > 1e-9:
+                warnings.warn(
+                    f"flywheel.sample_rate={fw.sample_rate} differs from "
+                    f"fleet.shadow_sample_percent="
+                    f"{self.fleet.shadow_sample_percent} while a shadow "
+                    f"challenger is armed: the flywheel join and shadow "
+                    f"scoring will read different traffic slices — align "
+                    f"the rates if the joined labels should explain the "
+                    f"shadow's divergence", stacklevel=2,
+                )
 
     # ---- overrides ------------------------------------------------------
 
@@ -1028,6 +1142,9 @@ class Config:
                 **known(FleetConfig, d.get("fleet", {}), "fleet")
             ),
             slo=SloConfig(**known(SloConfig, d.get("slo", {}), "slo")),
+            flywheel=FlywheelConfig(
+                **known(FlywheelConfig, d.get("flywheel", {}), "flywheel")
+            ),
         )
 
     @classmethod

@@ -1,0 +1,49 @@
+"""Data flywheel: serve → log → join → train on our own traffic.
+
+The paper's pipeline trains on an externally supplied dataset and the
+online trainer (``online/``) eats a hand-fed event stream — yet the
+serving tier already observes every impression it scores.  This package
+closes the loop with the classic delayed-feedback CTR join:
+
+* :mod:`.impressions` — a bounded, hash-stable-sampled logger hooked at
+  the pool router's response path; scored impressions land in the
+  ``online/stream.py`` immutable-segment format, and a full queue drops
+  with a metric — the serve path is never blocked.
+* :mod:`.join` — a standalone process (``python -m
+  deepfm_tpu.flywheel.join``) that tails the impression log and a
+  click-event log, matches clicks to impressions inside an attribution
+  window, synthesizes negatives when the window expires under a
+  watermark keyed to segment publish times, and emits joined labeled
+  examples as a stream the online trainer cursors over unchanged.
+  Its ``{cursors, pending-window}`` state commits atomically, and its
+  emission schedule is a pure function of (checkpoint, log contents), so
+  crash-resume re-publishes bit-identical segments instead of
+  double-emitting or dropping.
+* :mod:`.records` — the impression/click record codecs riding the
+  ``tf.train.Example`` wire format, plus the deterministic per-trace-id
+  sampling gate both the logger and the join recompute independently.
+
+``--task_type feedback-train`` (launch/cli.py) then points the existing
+online trainer at the join's output stream — train/publish/serve close
+into one self-improving loop.
+"""
+
+from .impressions import ImpressionLogger
+from .join import JoinService
+from .records import (
+    impression_sampled,
+    parse_click,
+    parse_impression,
+    serialize_click,
+    serialize_impression,
+)
+
+__all__ = [
+    "ImpressionLogger",
+    "JoinService",
+    "impression_sampled",
+    "parse_click",
+    "parse_impression",
+    "serialize_click",
+    "serialize_impression",
+]

@@ -33,8 +33,11 @@ def _coerce(value: str):
         return value
 
 
-def apply_set_overrides(cfg: Config, pairs: list[str]) -> Config:
-    sections: dict[str, dict] = {}
+def parse_set_pairs(pairs: list[str],
+                    sections: dict[str, dict] | None = None) -> dict:
+    """``section.key=value`` pairs folded into a ``with_overrides``
+    sections dict (merging into ``sections`` when given)."""
+    out: dict[str, dict] = sections if sections is not None else {}
     for pair in pairs:
         if "=" not in pair or "." not in pair.split("=", 1)[0]:
             raise SystemExit(
@@ -43,9 +46,13 @@ def apply_set_overrides(cfg: Config, pairs: list[str]) -> Config:
             )
         key, value = pair.split("=", 1)
         section, field = key.split(".", 1)
-        sections.setdefault(section, {})[field] = _coerce(value)
+        out.setdefault(section, {})[field] = _coerce(value)
+    return out
+
+
+def apply_set_overrides(cfg: Config, pairs: list[str]) -> Config:
     try:
-        return cfg.with_overrides(**sections)
+        return cfg.with_overrides(**parse_set_pairs(pairs))
     except TypeError as e:
         raise SystemExit(f"bad --set override: {e}") from None
 
@@ -59,14 +66,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--task_type",
         choices=["train", "eval", "infer", "export", "serve",
-                 "online-train", "online_train", "publish"],
+                 "online-train", "online_train",
+                 "feedback-train", "feedback_train", "publish"],
         help="task dispatch (reference ps:77-79; serve = online scoring "
              "over the exported servable; online-train = continuous "
              "training from an event log with versioned publishes the "
-             "serving engine hot-reloads; publish = the MPMD publisher "
-             "half of the elastic trainer/publisher split — tails "
-             "committed payloads in model_dir and publishes versioned "
-             "servables asynchronously, elastic/mpmd.py)",
+             "serving engine hot-reloads; feedback-train = online-train "
+             "over the data flywheel's joined impression/click stream "
+             "(flywheel.join_output_url, deepfm_tpu/flywheel); publish = "
+             "the MPMD publisher half of the elastic trainer/publisher "
+             "split — tails committed payloads in model_dir and "
+             "publishes versioned servables asynchronously, "
+             "elastic/mpmd.py)",
     )
     # the high-traffic flags get first-class spellings (parity with the
     # reference's most-used hyperparameters, ps nb cell 4)
@@ -183,10 +194,17 @@ def resolve_config(argv: list[str] | None = None) -> tuple[Config, argparse.Name
         value = getattr(args, flag)
         if value is not None:
             sections.setdefault(section, {})[field] = value
+    # --set pairs fold into the SAME with_overrides pass as the
+    # first-class flags: cross-section validation (e.g. feedback-train
+    # needs flywheel.join_output_url) must judge the fully-resolved
+    # config, never an intermediate state where only half the flags
+    # have landed
+    parse_set_pairs(args.set, sections)
     if sections:
-        cfg = cfg.with_overrides(**sections)
-    if args.set:
-        cfg = apply_set_overrides(cfg, args.set)
+        try:
+            cfg = cfg.with_overrides(**sections)
+        except TypeError as e:
+            raise SystemExit(f"bad --set override: {e}") from None
     if not args.no_env:
         cfg = Config.from_env(cfg)
     return cfg, args

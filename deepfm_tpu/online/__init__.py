@@ -10,9 +10,11 @@ from .stream import (  # noqa: F401
     DirectoryTail,
     EventLogReader,
     PrefixTail,
+    SegmentWriter,
     StreamCursor,
     append_segment,
     open_tail,
+    publish_segment,
     segment_name,
 )
 from .trainer import OnlinePayload, OnlineTrainer  # noqa: F401

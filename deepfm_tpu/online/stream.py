@@ -147,6 +147,26 @@ def open_tail(root: str) -> DirectoryTail | PrefixTail:
     return PrefixTail(root) if is_url(root) else DirectoryTail(root)
 
 
+def publish_segment(root: str, name: str, payload: bytes) -> str:
+    """Make one immutable segment visible atomically (producer side).
+
+    Local segments are written to a ``_tmp.`` name (tail listings skip the
+    ``_`` prefix) and renamed into place; remote segments are a single PUT
+    (objects appear whole or not at all).  Re-publishing an existing name
+    with identical bytes is a safe no-op either way — the idempotence the
+    flywheel join's publish-then-checkpoint crash window relies on.
+    Returns the segment name."""
+    if is_url(root):
+        get_store().put(join_url(root, name), payload)
+        return name
+    os.makedirs(root, exist_ok=True)
+    tmp = os.path.join(root, f"_tmp.{name}")
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, os.path.join(root, name))
+    return name
+
+
 def append_segment(
     root: str,
     labels: Sequence[float],
@@ -157,24 +177,115 @@ def append_segment(
 ) -> str:
     """Publish one immutable segment of CTR events (producer side).
 
-    Atomic visibility: local segments are written to a ``_tmp`` name and
-    renamed into place; remote segments are a single PUT (objects appear
-    whole or not at all).  Returns the segment name."""
-    name = segment_name(seq)
+    One-shot convenience over :func:`publish_segment`; returns the
+    segment name."""
     records = [
         serialize_ctr_example(float(labels[i]), ids[i], vals[i])
         for i in range(len(labels))
     ]
     payload = b"".join(frame_record(r) for r in records)
-    if is_url(root):
-        get_store().put(join_url(root, name), payload)
+    return publish_segment(root, segment_name(seq), payload)
+
+
+class SegmentWriter:
+    """Buffered producer with the size/age segment-roll policy.
+
+    Producers that emit records continuously (the flywheel impression
+    logger, the join service's output stream) share one question: *when
+    does the buffer become a segment?*  This writer owns the answer —
+    roll when the framed buffer reaches ``roll_bytes``, or when the
+    oldest buffered record has waited ``roll_age_secs`` (checked by
+    :meth:`poll`, which the owning drain loop ticks) — and the atomic
+    publish discipline of :func:`publish_segment`.
+
+    * ``roll_bytes <= 0`` disables the size trigger, ``roll_age_secs <= 0``
+      the age trigger; with both disabled only explicit :meth:`flush`
+      publishes (the join service does exactly this for its checkpoint-
+      aligned, deterministic output segments).
+    * The bytes trigger is a pure function of the appended records —
+      producers that must re-emit a bit-exact stream after a crash keep
+      determinism by never enabling the age trigger.
+    * Sequence numbers continue after existing segments in ``root`` so a
+      restarted producer never overwrites published history.
+
+    Single-writer: not thread-safe; the owning thread appends and polls.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        *,
+        roll_bytes: int = 1 << 20,
+        roll_age_secs: float = 10.0,
+        start_seq: int | None = None,
+        clock=time.time,
+    ):
+        self.root = root
+        self._roll_bytes = int(roll_bytes)
+        self._roll_age = float(roll_age_secs)
+        self._clock = clock
+        if start_seq is None:
+            names = open_tail(root).list_segments()
+            start_seq = (
+                int(names[-1].split(".", 1)[0]) + 1 if names else 0
+            )
+        self._seq = int(start_seq)
+        self._buf: list[bytes] = []
+        self._buf_bytes = 0
+        self._oldest: float | None = None
+        self.segments_published_total = 0
+        self.records_published_total = 0
+
+    @property
+    def next_seq(self) -> int:
+        return self._seq
+
+    @property
+    def pending_records(self) -> int:
+        return len(self._buf)
+
+    @property
+    def pending_bytes(self) -> int:
+        return self._buf_bytes
+
+    def append(self, record: bytes) -> str | None:
+        """Buffer one serialized record; returns the segment name when
+        this append tripped the size trigger, else None."""
+        framed = frame_record(record)
+        if self._oldest is None:
+            self._oldest = self._clock()
+        self._buf.append(framed)
+        self._buf_bytes += len(framed)
+        if self._roll_bytes > 0 and self._buf_bytes >= self._roll_bytes:
+            return self.flush()
+        return None
+
+    def poll(self) -> str | None:
+        """Age trigger: publish the buffer when its oldest record has
+        waited ``roll_age_secs``.  Drain loops tick this between appends
+        so a trickle of records still reaches readers promptly."""
+        if (
+            self._buf
+            and self._roll_age > 0
+            and self._clock() - self._oldest >= self._roll_age
+        ):
+            return self.flush()
+        return None
+
+    def flush(self) -> str | None:
+        """Publish all buffered records as the next segment (None when
+        the buffer is empty — an empty segment is never published)."""
+        if not self._buf:
+            return None
+        name = publish_segment(self.root, segment_name(self._seq),
+                               b"".join(self._buf))
+        self.records_published_total += len(self._buf)
+        self.segments_published_total += 1
+        self._seq += 1
+        self._buf = []
+        self._buf_bytes = 0
+        self._oldest = None
         return name
-    os.makedirs(root, exist_ok=True)
-    tmp = os.path.join(root, f"_tmp.{name}")
-    with open(tmp, "wb") as f:
-        f.write(payload)
-    os.replace(tmp, os.path.join(root, name))
-    return name
 
 
 class EventLogReader:

@@ -343,6 +343,27 @@ def main(argv: list[str] | None = None) -> int:
              "(bounded by --slo min_groups/max_groups; requires "
              "--router)",
     )
+    ap.add_argument(
+        "--flywheel-log", default="",
+        help="data flywheel (deepfm_tpu/flywheel): arm the router-side "
+             "impression logger writing scored impressions into this "
+             "segment-log root (dir or object URL); the join service "
+             "tails it against a click log",
+    )
+    ap.add_argument("--flywheel-sample", type=float, default=1.0,
+                    help="fraction of requests logged, hash-stable per "
+                         "impression id (trace id, else routing key)")
+    ap.add_argument("--flywheel-roll-bytes", type=int, default=1 << 20,
+                    help="impression segment roll: size trigger")
+    ap.add_argument("--flywheel-roll-age", type=float, default=10.0,
+                    help="impression segment roll: age trigger seconds")
+    ap.add_argument("--flywheel-queue", type=int, default=1024,
+                    help="bounded impression queue; overflow drops "
+                         "(counted), never blocks the serve path")
+    ap.add_argument("--flywheel-join-out", default="",
+                    help="the join service's output root: /v1/metrics "
+                         "then reports its last committed checkpoint "
+                         "(lag, pending window) next to the logger")
     ap.add_argument("--retry-limit", type=int, default=2)
     ap.add_argument("--eject-after", type=int, default=2)
     ap.add_argument("--health-interval", type=float, default=1.0)
@@ -458,6 +479,7 @@ def main(argv: list[str] | None = None) -> int:
     print(f"pool: {args.groups} shard-group(s) at "
           f"{ {g: u[0] for g, u in urls.items()} }", file=sys.stderr)
 
+    flywheel = None
     try:
         if args.router:
             from .router import Router, make_router_handler
@@ -504,6 +526,22 @@ def main(argv: list[str] | None = None) -> int:
                         after_pct=slo.hedge_after_pct,
                         budget=TokenBudget(slo.hedge_budget_pct / 100.0),
                     )
+            if args.flywheel_log:
+                from ...flywheel import ImpressionLogger
+
+                if registry is None:
+                    from ...obs.metrics import MetricsRegistry
+
+                    registry = MetricsRegistry()
+                flywheel = ImpressionLogger(
+                    args.flywheel_log,
+                    sample_rate=args.flywheel_sample,
+                    queue_depth=args.flywheel_queue,
+                    roll_bytes=args.flywheel_roll_bytes,
+                    roll_age_secs=args.flywheel_roll_age,
+                    join_output_url=args.flywheel_join_out,
+                    registry=registry,
+                ).start()
             router = Router(
                 urls, model_name=args.model_name,
                 retry_limit=args.retry_limit,
@@ -511,7 +549,7 @@ def main(argv: list[str] | None = None) -> int:
                 probe_interval_secs=args.health_interval,
                 split=split, shadow=shadow, registry=registry,
                 retry_budget=retry_budget, hedge=hedge,
-                shed_gate=shed_gate,
+                shed_gate=shed_gate, flywheel=flywheel,
             ).start()
             if args.autoscale:
                 _start_autoscaler(args, slo, router, shutdown,
@@ -533,6 +571,10 @@ def main(argv: list[str] | None = None) -> int:
         pass
     finally:
         shutdown.set()
+        if flywheel is not None:
+            # drain the queue and publish the tail segment — the last
+            # impressions before shutdown still reach the join
+            flywheel.stop()
         # stop every group's member + coordinators: signal all first,
         # then join, so teardown is parallel not serial
         with state_lock:

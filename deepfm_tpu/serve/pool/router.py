@@ -172,6 +172,7 @@ class Router:
         retry_budget=None,
         hedge=None,
         shed_gate=None,
+        flywheel=None,
     ):
         if not groups:
             raise ValueError("router needs at least one shard-group")
@@ -237,6 +238,11 @@ class Router:
         self._retry_budget = retry_budget
         self._hedge = hedge
         self._shed_gate = shed_gate
+        # data flywheel (deepfm_tpu/flywheel): an optional
+        # ImpressionLogger; answered requests are OFFERED after the
+        # response is formed — hash-stable sampling, bounded queue,
+        # drop-with-metric — so the serve path never waits on the log
+        self._flywheel = flywheel
         self._c_budget_exhausted = r.counter(
             "deepfm_router_retry_budget_exhausted_total",
             "retries/hedges suppressed: shared token budget empty")
@@ -713,6 +719,26 @@ class Router:
                     for sh in self._shadows:
                         if scored_by == sh.incumbent:
                             sh.offer(key, body, doc["predictions"])
+                    if self._flywheel is not None:
+                        # scored impression into the flywheel log —
+                        # same structural guarantee as the shadow
+                        # offer above (and _offer_shadow=False marks
+                        # a shadow re-score: never an impression)
+                        self._flywheel.offer(
+                            key=key,
+                            trace_id=(tctx.trace_id
+                                      if tctx is not None else ""),
+                            tenant=scored_by or "",
+                            model_version=int(
+                                doc.get("model_version", -1)),
+                            instances=body.get("instances", ()),
+                            scores=doc["predictions"],
+                            deadline_class=(
+                                priority if priority is not None
+                                else "deadline"
+                                if deadline_ms is not None
+                                else "default"),
+                        )
                 return 200, doc
             except urllib.error.HTTPError as e:
                 try:
@@ -840,6 +866,10 @@ class Router:
             out["router"]["hedge"] = self._hedge.snapshot()
         if self._shed_gate is not None:
             out["router"]["shed_gate"] = self._shed_gate.snapshot()
+        if self._flywheel is not None:
+            # impression-logger counters, plus the join service's last
+            # committed checkpoint when its output root is configured
+            out["flywheel"] = self._flywheel.stats()
         # the fleet view: per-tenant split share, routed requests and
         # router-measured latency, plus the shadow challenger's stats
         if self._split is not None or self._shadows:

@@ -751,6 +751,16 @@ def run_task(cfg: Config):
         from ..obs import flight as obs_flight
 
         obs_flight.install(os.path.join(cfg.run.model_dir, "flight.jsonl"))
+    if task in ("feedback-train", "feedback_train"):
+        # the data flywheel's training leg (deepfm_tpu/flywheel): the
+        # SAME online trainer (elastic path included), cursoring the
+        # delayed-label join's output stream instead of a hand-fed event
+        # log — config validation already required join_output_url
+        cfg = cfg.with_overrides(
+            data={"training_data_dir": cfg.flywheel.join_output_url},
+            run={"task_type": "online-train"},
+        )
+        task = "online-train"
     if task in ("online-train", "online_train"):
         # continuous training from the event log at training_data_dir,
         # publishing versioned servables the serve task hot-reloads
@@ -816,6 +826,21 @@ def run_task(cfg: Config):
                 argv += ["--funnel-top-k", str(cfg.run.funnel_top_k)]
             if cfg.run.funnel_return_n:
                 argv += ["--funnel-return-n", str(cfg.run.funnel_return_n)]
+            if cfg.flywheel.enabled:
+                # data flywheel (deepfm_tpu/flywheel): the router logs
+                # a hash-stable sample of scored impressions for the
+                # delayed-label join
+                fw = cfg.flywheel
+                argv += [
+                    "--flywheel-log", fw.impression_log_url,
+                    "--flywheel-sample", str(fw.sample_rate),
+                    "--flywheel-roll-bytes", str(fw.segment_roll_bytes),
+                    "--flywheel-roll-age",
+                    str(fw.segment_roll_age_secs),
+                    "--flywheel-queue", str(fw.queue_depth),
+                ]
+                if fw.join_output_url:
+                    argv += ["--flywheel-join-out", fw.join_output_url]
             pool_main(argv)
             return None
         if cfg.run.serve_workers > 1:
@@ -863,5 +888,6 @@ def run_task(cfg: Config):
         return run_export(cfg)
     raise ValueError(
         f"unknown task_type {task!r} "
-        f"(train|eval|infer|export|serve|online-train|publish)"
+        f"(train|eval|infer|export|serve|online-train|feedback-train|"
+        f"publish)"
     )

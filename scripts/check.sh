@@ -78,7 +78,11 @@ fi
 # predict and train step lower under transfer_guard('disallow') with no
 # host-callback custom_calls in the module and lower deterministically
 # across fresh builds (a host-timer value captured by the trace bakes a
-# different constant per retrace).
+# different constant per retrace).  The same audit re-lowers the serving
+# predict with a LIVE flywheel impression logger (deepfm_tpu/flywheel)
+# armed — worker thread running, an offer absorbed — proving the logger
+# stays on the router's host response path and never inside the jitted
+# predict (seeded violation: a logger call closed over the traced score).
 # — and the CONTROL-PLANE contract (audit_control_plane): the SLO control
 # plane (deepfm_tpu/serve/control — deadline-aware admission, the shed
 # ladder, hedging, autoscaling) is host-side policy; with the full plane

@@ -1669,6 +1669,46 @@ class TestObservabilityAudit:
         # keep create_train_state imported for the abstract state shape
         assert callable(create_train_state)
 
+    def test_seeded_flywheel_offer_under_trace_caught(self, tmp_path):
+        """A flywheel impression logger offered the TRACED score from
+        inside the jitted predict (the 'log from where the score is
+        born' mistake) concretizes the tracer — the audit's flywheel
+        section, which re-lowers with a live logger armed, reports it
+        instead of crashing."""
+        import jax
+
+        from deepfm_tpu.analysis.trace_audit import audit_observability
+        from deepfm_tpu.flywheel.impressions import ImpressionLogger
+
+        logger = ImpressionLogger(str(tmp_path), sample_rate=1.0).start()
+
+        def offering_builder(model, cfg):
+            @jax.jit
+            def predict_with(payload, feat_ids, feat_vals):
+                logits, _ = model.apply(
+                    payload["params"], payload["model_state"],
+                    feat_ids, feat_vals, cfg=cfg.model, train=False,
+                )
+                out = jax.nn.sigmoid(logits)
+                # the traced score is offered to the logger — float()
+                # on the tracer concretizes; the contract is that the
+                # offer happens on the HOST after the response doc
+                # (serve/pool/router.py _try_group), never here
+                logger.offer(
+                    key="seeded", instances=[{}], scores=[out[0]])
+                return out
+
+            return predict_with
+
+        try:
+            findings = audit_observability(
+                predict_builder=offering_builder)
+        finally:
+            logger.stop()
+        assert any(f.rule == "trace-observability"
+                   and "flywheel" in f.message for f in findings), \
+            "\n".join(f.render() for f in findings)
+
 
 # ------------------------------------------------------------ control plane
 
