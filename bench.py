@@ -512,6 +512,27 @@ def main() -> None:
 
         r = multitenant.main()
         sys.exit(0 if r["ok"] else 1)
+    if len(sys.argv) > 1 and sys.argv[1] == "--multiregion":
+        # the cross-region gate (benchmarks/multiregion.py): two regions
+        # (pool + region store each) behind the region front, manifests
+        # replicated marker-last from the home root; kills one region
+        # mid-load and FAILS (exit 1) on any admitted-then-failed
+        # request, a post-failover tail outside the SLO, a stale-but-
+        # healthy region re-admitted before its store caught up, or
+        # post-recovery traffic off the newest version / off its home
+        # region.  Emits docs/BENCH_MULTIREGION.json.  CPU virtual mesh
+        # by design — the drill measures the region control plane
+        # (audit_region_front pins it out of the lowered predict).
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        sys.argv = [sys.argv[0], "--persist"] + sys.argv[2:]
+        import multiregion
+
+        sys.exit(multiregion.main())
     if len(sys.argv) > 1 and sys.argv[1] == "--slo":
         # the SLO control-plane gate (benchmarks/slo_control.py): one
         # diurnal + 10x-spike trace against a static 2-group pool vs the

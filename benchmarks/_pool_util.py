@@ -98,10 +98,14 @@ class PoolProcess:
         health_interval: float = 0.2,
         env: dict | None = None,
         extra_argv: tuple = (),
+        port: int | None = None,
     ):
         import os
 
-        self.router_port = free_port()
+        # a fixed port lets a killed pool come back at the SAME address
+        # (the multiregion drill restarts a region behind a front that
+        # probes a fixed router_url)
+        self.router_port = port if port is not None else free_port()
         self.router_url = f"http://127.0.0.1:{self.router_port}"
         self._stopped = False
         run_env = dict(os.environ, JAX_PLATFORMS="cpu")

@@ -2198,6 +2198,168 @@ def audit_zero_update(cfg=None, context_builder=None) -> list[Finding]:
     return out
 
 
+def audit_region_front(cfg=None, predict_builder=None) -> list[Finding]:
+    """The cross-region contract: the region layer (deepfm_tpu/region —
+    rendezvous home assignment, replication lag tracking, the staleness
+    SLO drain edge, budgeted failover) is pure control plane.  No jitted
+    graph and no model bytes belong on the front path: the front
+    forwards opaque payloads between pools, and every region decision
+    reads host state.
+
+    Two holds:
+
+    * **import hygiene** — no module under ``deepfm_tpu/region`` may
+      import jax (statically, by AST walk): a front that can touch
+      device arrays is one refactor away from scoring on the routing
+      tier;
+    * **lowering** — with a live, fed region front (regions ranked,
+      versions observed, a drain edge crossed, failover budget spent),
+      the REAL serving predict must still lower under
+      ``jax.transfer_guard("disallow")``, callback-free and
+      deterministically — a routing or staleness decision that reads a
+      traced value (say, a home pick keyed on the model's own score)
+      concretizes here.
+
+    ``predict_builder(model, cfg)`` lets the seeded-violation tests
+    (tests/test_analysis.py) feed both failure shapes through the same
+    checks."""
+    import ast
+    import inspect
+
+    import jax
+
+    out: list[Finding] = []
+    cfg = cfg or _audit_cfg()
+    where = "deepfm_tpu/region"
+    from .. import region as _region_pkg
+    from ..region import front as _front_mod
+    from ..region import replicator as _repl_mod
+
+    for mod in (_region_pkg, _front_mod, _repl_mod):
+        try:
+            tree = ast.parse(inspect.getsource(mod))
+        except (OSError, SyntaxError):  # pragma: no cover - source gone
+            continue
+        for node in ast.walk(tree):
+            names: list[str] = []
+            if isinstance(node, ast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                names = [node.module]
+            bad = [n for n in names
+                   if n == "jax" or n.startswith("jax.")]
+            if bad:
+                out.append(_finding(
+                    "trace-region-front",
+                    f"{mod.__name__} imports {bad[0]} — the region "
+                    f"layer is pure control plane and must stay "
+                    f"importable (and correct) with no device runtime "
+                    f"at all",
+                    hint="route, replicate and drain on host state; "
+                         "model bytes never touch the front path",
+                    where=where, slug="region-jax-import",
+                ))
+    # the region machinery itself is plain host code: construct it
+    # whole and walk every decision edge the live front takes
+    from ..fleet.split import rendezvous_arm, rendezvous_ranking
+    from ..region.front import RegionFront
+
+    try:
+        regions = {
+            name: {"router_url": f"http://invalid.test:1/{name}",
+                   "store_root": ""}
+            for name in ("use1", "euw1", "apne1")
+        }
+        front = RegionFront(regions, max_version_skew=2,
+                            readmit_version_skew=0)
+        for i in range(16):
+            key = f"user-{i}"
+            ranking = rendezvous_ranking(key, sorted(regions))
+            assert rendezvous_arm(key, sorted(regions)) == ranking[0]
+        for name in regions:
+            front.note_store_version(name, 5)
+        front.note_home_version(5)
+        front.plan("user-0")
+        front.home("user-0")
+        front.note_home_version(9)   # skew 4 > 2: the drain edge
+        front.note_store_version("use1", 9)  # ...and the catch-up edge
+        front.retry_budget.note_request()
+        front.retry_budget.try_spend()
+        front.status()
+    except Exception as e:
+        out.append(_finding(
+            "trace-region-front",
+            f"constructing/feeding the region front raised "
+            f"{type(e).__name__}: {e} — the region layer must run as "
+            f"plain host code (no device, no trace, no jax)",
+            hint="deepfm_tpu/region holds pure host policy; keep jax "
+                 "out of it",
+            where=where, slug="region-host-policy",
+        ))
+        return out
+    # with that front alive, the serving predict must lower exactly as
+    # it would without one
+    from ..serve.reload import build_predict_with
+
+    f = cfg.model.field_size
+    b = _default_buckets()[0]
+    args = (
+        jax.ShapeDtypeStruct((b, f), jax.numpy.int64),
+        jax.ShapeDtypeStruct((b, f), jax.numpy.float32),
+    )
+    model, payload = _abstract_payload(cfg)
+    build_p = predict_builder or build_predict_with
+    texts: list[str] = []
+    try:
+        with jax.transfer_guard("disallow"):
+            for _ in range(2):
+                texts.append(
+                    build_p(model, cfg).lower(payload, *args).as_text()
+                )
+    except Exception as e:
+        out.append(_finding(
+            "trace-region-front",
+            f"lowering the serving predict with the region front "
+            f"active raised {type(e).__name__}: {e} — a routing or "
+            f"staleness decision ran under trace (closed over a traced "
+            f"value, or forced an implicit transfer)",
+            hint="home picks, drain edges and failover spends read "
+                 "host state; none of them may read a traced value",
+            where=where, slug="region-predict-lower",
+        ))
+        return out
+    cb_lines = [
+        ln.strip()[:160] for ln in texts[0].splitlines()
+        if "custom_call" in ln and _CALLBACK_MARKER in ln.lower()
+    ]
+    if cb_lines:
+        out.append(_finding(
+            "trace-region-front",
+            f"the serving predict lowers WITH a host callback under "
+            f"the region front ({len(cb_lines)} custom_call(s), first: "
+            f"{cb_lines[0]!r}) — a region decision was smuggled into "
+            f"the graph via io_callback and will sync the device on "
+            f"every dispatch",
+            hint="the front forwards requests on host threads "
+                 "(region/front.py); nothing decides inside jit",
+            where=where, slug="region-predict-callback",
+        ))
+    if len(texts) > 1 and texts[0] != texts[1]:
+        out.append(_finding(
+            "trace-region-front",
+            "two successive lowerings of the serving predict differ "
+            "under the live region front — a region reading (skew "
+            "gauge, budget token count, ranking) was baked into the "
+            "trace as a constant, so every retrace builds a different "
+            "executable",
+            hint="region state changes per probe tick; read it on the "
+                 "host at routing time instead",
+            where=where, slug="region-predict-nondeterministic",
+        ))
+    return out
+
+
 def run_trace_audit(cfg=None) -> list[Finding]:
     """All engine-2 audits against the real entrypoints (abstract values
     only; no step executes).  Importing jax is the price of admission —
@@ -2215,4 +2377,5 @@ def run_trace_audit(cfg=None) -> list[Finding]:
     findings.extend(audit_elastic(cfg))
     findings.extend(audit_observability(cfg))
     findings.extend(audit_control_plane(cfg))
+    findings.extend(audit_region_front(cfg))
     return findings

@@ -771,6 +771,112 @@ class FlywheelConfig:
 
 
 @dataclass(frozen=True)
+class RegionsConfig:
+    """Cross-region active-active serving (``deepfm_tpu/region``): one
+    pool + one model store per region, an async manifest replicator
+    keeping every region store behind-but-never-torn (marker-last order
+    preserved per region), and a front tier routing each user to a
+    hash-stable home region with staleness-SLO-gated failover.  All
+    host-side control plane — ``audit_region_front`` proves none of it
+    enters the jitted predict."""
+
+    # arm the region layer (task_type=region-front)
+    enabled: bool = False
+    # region cells: each entry {"name", "router_url", "store_root"} —
+    # the region pool's router endpoint and the region-local publish
+    # root its hot-reload tails (dir or object URL)
+    regions: tuple = ()
+    # the home publish root the replicator mirrors into region stores
+    home_root: str = ""
+    # front tier bind address
+    front_host: str = "127.0.0.1"
+    front_port: int = 8400
+    # replicator tail cadence over the home root
+    replication_poll_secs: float = 1.0
+    # whole-region health probe cadence and consecutive failures before
+    # ejection (traffic-observed failures count toward the same bar)
+    probe_interval_secs: float = 1.0
+    eject_after: int = 2
+    # -- staleness SLO (model-version skew, in committed versions) ------
+    # a region whose store is more than this many versions behind the
+    # home root flips to drain-and-catch-up instead of serving
+    # stale-beyond-SLO scores
+    max_version_skew: int = 2
+    # re-admission bar (hysteresis): a drained or ejected region takes
+    # traffic again only once its skew is back at or below this
+    readmit_version_skew: int = 0
+    # cross-region failover token budget, percent of the recent request
+    # rate — beyond it the front fails fast (503 + Retry-After) so a
+    # region brownout cannot cascade into a retry storm
+    failover_budget_pct: float = 10.0
+    # retention floor at the home root: the publisher keeps at least
+    # this many versions (max with run.keep_checkpoints) so a region
+    # lagging inside the SLO can still fetch what it is catching up to
+    # (0 = no widening)
+    publish_keep_window: int = 0
+
+    def __post_init__(self):
+        import math
+
+        if self.enabled:
+            if not self.regions:
+                raise ValueError(
+                    "regions.enabled needs at least one region entry"
+                )
+            if not self.home_root:
+                raise ValueError(
+                    "regions.enabled needs regions.home_root — the "
+                    "replicator has nothing to tail"
+                )
+        names = []
+        for entry in self.regions:
+            if not isinstance(entry, dict) or not entry.get("name") \
+                    or not entry.get("router_url"):
+                raise ValueError(
+                    f"each regions.regions entry needs 'name' and "
+                    f"'router_url' (got {entry!r})"
+                )
+            names.append(entry["name"])
+        if len(names) != len(set(names)):
+            raise ValueError(
+                f"regions.regions names must be unique, got {names}"
+            )
+        if self.max_version_skew < 0 or self.readmit_version_skew < 0:
+            raise ValueError(
+                "regions version-skew bounds must be >= 0"
+            )
+        if self.readmit_version_skew > self.max_version_skew:
+            raise ValueError(
+                f"regions.readmit_version_skew="
+                f"{self.readmit_version_skew} must not exceed "
+                f"max_version_skew={self.max_version_skew} — the "
+                f"re-admit bar cannot be laxer than the drain bar"
+            )
+        if not (0.0 <= self.failover_budget_pct <= 100.0
+                and math.isfinite(self.failover_budget_pct)):
+            raise ValueError(
+                f"regions.failover_budget_pct must be a percent in "
+                f"[0, 100], got {self.failover_budget_pct}"
+            )
+        for name in ("replication_poll_secs", "probe_interval_secs"):
+            v = getattr(self, name)
+            if not (v > 0 and math.isfinite(v)):
+                raise ValueError(
+                    f"regions.{name} must be finite and > 0, got {v}"
+                )
+        if self.eject_after < 1:
+            raise ValueError(
+                f"regions.eject_after must be >= 1, got "
+                f"{self.eject_after}"
+            )
+        if self.publish_keep_window < 0:
+            raise ValueError(
+                f"regions.publish_keep_window must be >= 0, got "
+                f"{self.publish_keep_window}"
+            )
+
+
+@dataclass(frozen=True)
 class RunConfig:
     """Run/driver config: task dispatch + paths (ps:70-79) + cluster identity
     (SM_HOSTS/SM_CURRENT_HOST analogs, ps:80-95)."""
@@ -891,6 +997,7 @@ class Config:
     fleet: FleetConfig = field(default_factory=FleetConfig)
     slo: SloConfig = field(default_factory=SloConfig)
     flywheel: FlywheelConfig = field(default_factory=FlywheelConfig)
+    regions: RegionsConfig = field(default_factory=RegionsConfig)
 
     def __post_init__(self):
         """Cross-section contracts no single section can check.
@@ -1088,6 +1195,27 @@ class Config:
                     f"the rates if the joined labels should explain the "
                     f"shadow's divergence", stacklevel=2,
                 )
+        # 7. cross-region serving: the home root's retention window must
+        # cover the staleness SLO — a region allowed to run
+        # max_version_skew versions behind will FETCH those versions
+        # from the home root while catching up, so retaining fewer than
+        # skew+1 versions can delete a version a still-inside-SLO region
+        # is mid-fetch on (region/replicator.py).
+        rg = self.regions
+        if rg.enabled:
+            window = max(self.run.keep_checkpoints,
+                         rg.publish_keep_window)
+            if window < rg.max_version_skew + 1:
+                warnings.warn(
+                    f"regions.publish_keep_window={rg.publish_keep_window}"
+                    f" (effective retention {window} with "
+                    f"run.keep_checkpoints={self.run.keep_checkpoints}) "
+                    f"is under max_version_skew+1="
+                    f"{rg.max_version_skew + 1}: home retention can "
+                    f"delete a version a lagging-but-inside-SLO region "
+                    f"is still catching up to — widen the keep window",
+                    stacklevel=2,
+                )
 
     # ---- overrides ------------------------------------------------------
 
@@ -1144,6 +1272,9 @@ class Config:
             slo=SloConfig(**known(SloConfig, d.get("slo", {}), "slo")),
             flywheel=FlywheelConfig(
                 **known(FlywheelConfig, d.get("flywheel", {}), "flywheel")
+            ),
+            regions=RegionsConfig(
+                **known(RegionsConfig, d.get("regions", {}), "regions")
             ),
         )
 

@@ -169,6 +169,7 @@ class PayloadPublisher:
         self.publisher = ModelPublisher(
             cfg.run.servable_model_dir,
             keep=max(2, cfg.run.keep_checkpoints),
+            keep_window=cfg.regions.publish_keep_window,
         )
         self._log = MetricLogger(log_steps=cfg.run.log_steps)
         self._client: CoordClient | None = None

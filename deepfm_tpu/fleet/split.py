@@ -48,6 +48,36 @@ def sampled(key: str, percent: float, salt: str = "shadow") -> bool:
     return split_point(key, salt) < int(percent / 100.0 * SPACE)
 
 
+def rendezvous_ranking(key: str, arms, salt: str = "region") -> list[str]:
+    """All ``arms`` ordered by descending rendezvous (highest-random-
+    weight) score for ``key`` — the home-region assignment primitive.
+
+    Each (key, arm) pair gets an independent uniform score from the same
+    ``split_point`` hash the split arms ride; the winner is the key's
+    home, and the rest are its deterministic failover order.  Unlike
+    ``TrafficSplit``'s cumulative boundaries, removing an arm moves ONLY
+    the keys that ranked it first (they fall through to their
+    pre-computed second choice, already next in this list); every other
+    key's full ranking is unchanged, and re-adding the arm restores the
+    exact original assignment — the ring-churn discipline without a ring.
+    Score ties (astronomically rare at SPACE resolution) break by arm
+    name so every caller agrees."""
+    ranked = sorted(
+        arms,
+        key=lambda a: (-split_point(key, salt=f"{salt}|{a}"), a),
+    )
+    if not ranked:
+        raise ValueError("rendezvous_ranking needs at least one arm")
+    return ranked
+
+
+def rendezvous_arm(key: str, arms, salt: str = "region") -> str:
+    """The highest-random-weight winner for ``key`` over ``arms`` — a
+    pure function of the key bytes and the arm NAMES (declaration order
+    irrelevant), minimal-movement under arm add/remove."""
+    return rendezvous_ranking(key, arms, salt=salt)[0]
+
+
 class TrafficSplit:
     """Percentage split over named arms with hash-stable assignment.
 

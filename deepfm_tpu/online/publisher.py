@@ -101,7 +101,16 @@ class Manifest:
 # -- read side (used by serve/reload.py and tooling) ------------------------
 
 def list_versions(root: str) -> list[int]:
-    """Committed (manifest-bearing) versions under ``root``, ascending."""
+    """Committed (manifest-bearing) versions under ``root``, ascending.
+
+    With :func:`resolve_version` this is the replicator's tail pairing
+    (deepfm_tpu/region/replicator.py): because only MANIFEST objects are
+    listed and the manifest is always written LAST, every version this
+    returns already has its complete artifact tree on the store — a
+    tailer that iterates ``list_versions`` and then ``resolve_version``
+    per entry can never race "latest" apart into a manifest without
+    bytes (or bytes without a manifest).  Uncommitted ``versions/<v>/``
+    trees are invisible here by construction."""
     versions = []
     if is_url(root):
         base = root.rstrip("/") + "/"
@@ -171,7 +180,15 @@ def resolve_version(
     ``latest_manifest`` (two members resolving different "latest"s would
     be exactly the mixed-version state the group swap exists to prevent).
     Manifest first (a missing manifest means the version is uncommitted —
-    fail before moving bytes), then the artifact via ``fetch_version``."""
+    fail before moving bytes), then the artifact via ``fetch_version``.
+
+    The cross-region replicator tails exactly this pairing: versions come
+    from ``list_versions`` (committed only), each is resolved HERE by its
+    explicit number — never via ``latest_manifest`` — so a publish that
+    lands mid-tail is simply picked up on the next pass instead of
+    tearing the read apart.  The version a lagging region is catching up
+    to stays fetchable because retention keeps a configurable window
+    (``ModelPublisher(keep_window=...)``) beyond the serving ``keep``."""
     manifest = read_manifest(root, version)
     local = fetch_version(root, version, staging_dir)
     return manifest, local
@@ -187,13 +204,26 @@ class ModelPublisher:
     orphaned ``versions/<v>/`` prefix a failed attempt left behind, then
     re-uploads the tree and re-PUTs the manifest last, so a half-uploaded
     tree can never mix stale objects into the committed version (the
-    reader's param-hash check would reject it forever)."""
+    reader's param-hash check would reject it forever).
 
-    def __init__(self, root: str, *, keep: int = 3, retry=None):
+    ``keep_window`` widens retention beyond ``keep`` (the effective
+    window is ``max(keep, keep_window)``): with cross-region replication
+    armed (deepfm_tpu/region), a region store that is N versions behind
+    still has to FETCH the versions it is catching up to from this root
+    — a keep window sized at the regions config's staleness SLO plus
+    headroom guarantees a lagging-but-inside-SLO region never chases a
+    version retention already deleted."""
+
+    def __init__(self, root: str, *, keep: int = 3, retry=None,
+                 keep_window: int = 0):
         if keep < 1:
             raise ValueError(f"keep must be >= 1, got {keep}")
+        if keep_window < 0:
+            raise ValueError(
+                f"keep_window must be >= 0, got {keep_window}"
+            )
         self.root = root.rstrip("/") if is_url(root) else root
-        self._keep = keep
+        self._keep = max(keep, keep_window)
         if retry is None:
             from ..utils.retry import RetryPolicy
 

@@ -241,7 +241,8 @@ class OnlineTrainer:
             batch_size=cfg.data.batch_size,
         )
         self.publisher = ModelPublisher(
-            self._publish_root, keep=max(2, cfg.run.keep_checkpoints)
+            self._publish_root, keep=max(2, cfg.run.keep_checkpoints),
+            keep_window=cfg.regions.publish_keep_window,
         )
         self._log = MetricLogger(log_steps=cfg.run.log_steps)
 

@@ -1,0 +1,34 @@
+"""Cross-region active-active serving (the region layer).
+
+Composes the fenced marker-last publish protocol (online/publisher.py,
+PR 12), the PR 3 retry/breaker/FaultPlan fault machinery, the PR 7 pool
+routers and the PR 14 TokenBudget into cells: one serving pool + one
+model store per region, an async :class:`ManifestReplicator` keeping
+every region store behind-but-never-torn, and a :class:`RegionFront`
+routing each user to a hash-stable home region with staleness-gated
+cross-region failover.
+
+Everything here is pure host-side control plane — no jax imports, no
+model bytes on the front path (``audit_region_front`` pins it).
+"""
+
+from .front import RegionFront, make_front_handler, start_front
+from .replicator import ManifestReplicator
+
+
+def run_region_front(cfg):
+    """The ``task_type=region-front`` entrypoint (train/loop.py
+    run_task): start the manifest replicator over cfg.regions' stores
+    and serve the front tier until interrupted."""
+    from .__main__ import run_from_config
+
+    return run_from_config(cfg)
+
+
+__all__ = [
+    "ManifestReplicator",
+    "RegionFront",
+    "make_front_handler",
+    "run_region_front",
+    "start_front",
+]

@@ -360,6 +360,7 @@ def run_train_tiered(cfg: Config):
             manifest = ModelPublisher(
                 cfg.run.servable_model_dir,
                 keep=cfg.run.keep_checkpoints,
+                keep_window=cfg.regions.publish_keep_window,
             ).publish_tiered(cfg, trainer)
             log.event("publish_tiered", version=manifest.version,
                       step=manifest.step)
@@ -783,6 +784,15 @@ def run_task(cfg: Config):
         from ..elastic.mpmd import run_publisher
 
         return run_publisher(cfg)
+    if task in ("region-front", "region_front"):
+        # cross-region control process (deepfm_tpu/region): the async
+        # manifest replicator tailing cfg.regions.home_root into every
+        # region store plus the front tier (home-region routing,
+        # staleness-SLO drain, budgeted failover).  Host-only — the
+        # per-region pools are their own `task_type=serve` processes.
+        from ..region import run_region_front
+
+        return run_region_front(cfg)
     if task == "serve":
         from ..serve.server import serve_forever, serve_pool
 
@@ -889,5 +899,5 @@ def run_task(cfg: Config):
     raise ValueError(
         f"unknown task_type {task!r} "
         f"(train|eval|infer|export|serve|online-train|feedback-train|"
-        f"publish)"
+        f"publish|region-front)"
     )

@@ -277,12 +277,17 @@ def _make_handler(root: str, max_keys: int, plan: FaultPlan):
         def _do_list(self, parsed, q) -> None:
             bucket = parsed.path.strip("/")
             bucket_dir = self._path_for("/" + bucket)
-            if bucket_dir is None or not os.path.isdir(bucket_dir):
-                return self._send(404, b"no such bucket", "text/plain")
+            if bucket_dir is None:
+                return self._send(403, b"traversal", "text/plain")
+            # buckets are created implicitly by the first PUT, so a
+            # never-written bucket lists as empty (the bootstrap state a
+            # fresh region store starts in), not as an error
+            if not os.path.isdir(bucket_dir):
+                bucket_dir = None
             prefix = q.get("prefix", [""])[0]
             token = q.get("continuation-token", [""])[0]
             keys = []
-            for r, _, files in os.walk(bucket_dir):
+            for r, _, files in (os.walk(bucket_dir) if bucket_dir else ()):
                 for name in files:
                     rel = os.path.relpath(os.path.join(r, name), bucket_dir)
                     key = rel.replace(os.sep, "/")

@@ -30,14 +30,22 @@ fi
 # (tests/test_control_chaos.py): a FaultPlan latency window stalls one
 # shard-group mid-load — hedges must engage, the stalled group must NOT
 # be ejected, the hedge rate must decay to zero after the heal, and zero
-# admitted requests may fail.  Off by default: each drill trains two
-# full runs and serves under load (~minutes), which does not belong in
-# the per-commit static gate.
+# admitted requests may fail; (4) the REGION-LOSS drill
+# (tests/test_region_chaos.py): two regions (serving pool + region store
+# each) behind the region front with manifests replicated marker-last
+# from the home root — one region killed mid-load must fail over with 0
+# admitted-then-failed requests and an in-SLO tail, and the restored
+# region must stay OUT while its store is stale beyond the version-skew
+# SLO, re-admitting only after the replicator catches it up (emits
+# docs/BENCH_MULTIREGION.json via `python bench.py --multiregion`).
+# Off by default: each drill trains two full runs and serves under load
+# (~minutes), which does not belong in the per-commit static gate.
 if [[ "${CHECK_SLOW:-0}" == "1" || "${1:-}" == "--slow" || "${2:-}" == "--slow" ]]; then
     env JAX_PLATFORMS=cpu \
         XLA_FLAGS="${XLA_FLAGS:+$XLA_FLAGS }--xla_force_host_platform_device_count=8" \
         python -m pytest tests/test_elastic_chaos.py \
         tests/test_elastic_multihost.py tests/test_control_chaos.py \
+        tests/test_region_chaos.py \
         -q -m slow \
         -p no:cacheprovider
 fi
@@ -90,14 +98,23 @@ fi
 # still lower transfer-guard-clean, callback-free and deterministically
 # (an admission decision reading a traced value, or a scale decision
 # smuggled in via io_callback, fails the gate).
+# — and the REGION-FRONT contract (audit_region_front): the cross-region
+# layer (deepfm_tpu/region — rendezvous home assignment, manifest
+# replication lag, the staleness-SLO drain edge, budgeted failover) is
+# pure control plane: statically jax-free by AST walk, runnable as plain
+# host code with no device, and with a live fed region front the serving
+# predict must still lower transfer-guard-clean, callback-free and
+# deterministically (a staleness observation fed from a traced value, or
+# a home pick smuggled in via io_callback, fails the gate).
 # Seeded violations in tests/test_analysis.py (smuggled transfer,
 # dense-row leak, off-bucket/indivisible shape, baked mixed-generation
 # payload, spec-divergent tenants claiming one executable, baked tenant
 # payload, full-corpus score gather, baked index, reshard host round-trip,
 # baked reshard table, host timer closed over a traced value, registry
 # call inside a jitted fn, admission check on a traced queue depth,
-# io_callback scale decision inside jit) prove each contract actually
-# catches its regression.
+# io_callback scale decision inside jit, staleness note on a traced
+# version, io_callback home pick inside jit) prove each contract
+# actually catches its regression.
 exec env JAX_PLATFORMS=cpu \
     XLA_FLAGS="${XLA_FLAGS:+$XLA_FLAGS }--xla_force_host_platform_device_count=8" \
     python -m deepfm_tpu.analysis deepfm_tpu \

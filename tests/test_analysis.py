@@ -1801,3 +1801,114 @@ class TestControlPlaneAudit:
         assert any(f.rule == "trace-control-plane"
                    and "host callback" in f.message for f in findings), \
             "\n".join(f.render() for f in findings)
+
+
+class TestRegionFrontAudit:
+    """audit_region_front: the region layer (rendezvous homes,
+    replication lag, staleness drain, budgeted failover) is pure control
+    plane — statically jax-free, runnable with no device, and invisible
+    to the lowered serving graph.  The real predict passes with a live,
+    fed region front; each seeded violation is a way a cross-region
+    patch could leak a routing decision into the executables."""
+
+    def test_real_predict_holds_under_live_region_front(self):
+        from deepfm_tpu.analysis.trace_audit import audit_region_front
+
+        findings = audit_region_front()
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_region_package_is_statically_jax_free(self):
+        """The import-hygiene hold inspects real sources: nothing under
+        deepfm_tpu/region imports jax today (construction would also
+        catch it, but the AST walk convicts even unused imports)."""
+        import ast
+        import inspect
+
+        from deepfm_tpu import region as pkg
+        from deepfm_tpu.region import front, replicator
+
+        for mod in (pkg, front, replicator):
+            tree = ast.parse(inspect.getsource(mod))
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Import):
+                    names = [a.name for a in node.names]
+                elif isinstance(node, ast.ImportFrom) and node.module \
+                        and node.level == 0:
+                    names = [node.module]
+                else:
+                    continue
+                assert not any(n == "jax" or n.startswith("jax.")
+                               for n in names), \
+                    f"{mod.__name__} imports jax: {names}"
+
+    def test_seeded_staleness_decision_on_traced_value_caught(self):
+        """A staleness observation fed from the model's own output is a
+        traced value — int() concretizes it at trace time and the audit
+        reports the lowering failure as a finding instead of crashing."""
+        import jax
+
+        from deepfm_tpu.analysis.trace_audit import audit_region_front
+        from deepfm_tpu.region.front import RegionFront
+
+        front = RegionFront(
+            {"use1": {"router_url": "http://invalid.test:1/u",
+                      "store_root": ""}})
+
+        def bad_builder(model, cfg):
+            @jax.jit
+            def predict_with(payload, feat_ids, feat_vals):
+                logits, _ = model.apply(
+                    payload["params"], payload["model_state"],
+                    feat_ids, feat_vals, cfg=cfg.model, train=False,
+                )
+                out = jax.nn.sigmoid(logits)
+                # the version the staleness SLO compares against is a
+                # traced value — int() concretizes it at trace time
+                front.note_store_version("use1", int(out[0] * 1000))
+                return out
+
+            return predict_with
+
+        findings = audit_region_front(predict_builder=bad_builder)
+        assert any(f.rule == "trace-region-front"
+                   and "routing or staleness decision" in f.message
+                   for f in findings), \
+            "\n".join(f.render() for f in findings)
+
+    def test_seeded_home_pick_in_jit_caught(self):
+        """A home-region pick smuggled into the graph via io_callback
+        lowers as a host-callback custom_call — convicted by the
+        callback scan."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.experimental import io_callback
+
+        from deepfm_tpu.analysis.trace_audit import audit_region_front
+        from deepfm_tpu.fleet.split import rendezvous_arm
+
+        def _pick(v):
+            rendezvous_arm(f"user-{float(v):.3f}", ["use1", "euw1"])
+            return np.float32(0.0)
+
+        def bad_builder(model, cfg):
+            @jax.jit
+            def predict_with(payload, feat_ids, feat_vals):
+                logits, _ = model.apply(
+                    payload["params"], payload["model_state"],
+                    feat_ids, feat_vals, cfg=cfg.model, train=False,
+                )
+                out = jax.nn.sigmoid(logits)
+                # the home pick rides the dispatch
+                zero = io_callback(
+                    _pick, jax.ShapeDtypeStruct((), jnp.float32),
+                    out[0],
+                )
+                return out + zero
+
+            return predict_with
+
+        findings = audit_region_front(predict_builder=bad_builder)
+        assert any(f.rule == "trace-region-front"
+                   and "host callback" in f.message for f in findings), \
+            "\n".join(f.render() for f in findings)
