@@ -1,6 +1,6 @@
 """JAX-aware static analysis suite (``python -m deepfm_tpu.analysis``).
 
-Two engines over the package (docs/ARCHITECTURE.md "Static analysis &
+Three engines over the package (docs/ARCHITECTURE.md "Static analysis &
 correctness gates"):
 
 * **engine 1** (`ast_rules`, `guarded_by`) — a parse-only AST pass with
@@ -11,7 +11,11 @@ correctness gates"):
   lowering-level contracts without executing a step: no implicit
   transfers under ``jax.transfer_guard("disallow")``, bucket-shape →
   executable coverage (no silent recompiles), hot-swap-is-a-cache-hit,
-  train-step donation, and dtype promotion.
+  train-step donation, and dtype promotion;
+* **engine 3** (`callgraph`, `concurrency`, ``--concurrency``) — a
+  parse-only interprocedural concurrency pass: lock-order cycles,
+  blocking-under-lock (transitively through resolved calls),
+  signal-handler lock safety, and thread-lifecycle lint.
 
 Findings carry file:line, rule id, fix hint, and a stable fingerprint;
 ``analysis_baseline.json`` ratchets accepted debt (baseline.py) and
@@ -20,11 +24,15 @@ Findings carry file:line, rule id, fix hint, and a stable fingerprint;
 
 from .ast_rules import analyze_modules
 from .baseline import load_baseline, partition, write_baseline
+from .callgraph import CallGraph
 from .cli import main, run_ast_engine
+from .concurrency import CONCURRENCY_RULES, run_concurrency_engine
 from .findings import RULES, Finding, apply_suppressions, fingerprint_findings
 from .guarded_by import check_guarded_by
 
 __all__ = [
+    "CONCURRENCY_RULES",
+    "CallGraph",
     "Finding",
     "RULES",
     "analyze_modules",
@@ -35,5 +43,6 @@ __all__ = [
     "main",
     "partition",
     "run_ast_engine",
+    "run_concurrency_engine",
     "write_baseline",
 ]

@@ -30,6 +30,7 @@ from .findings import (
     fingerprint_findings,
     load_suppressions,
 )
+from .concurrency import CONCURRENCY_RULES, run_concurrency_engine
 from .guarded_by import check_guarded_by
 
 DEFAULT_BASELINE = "analysis_baseline.json"
@@ -76,17 +77,26 @@ def _collect_files(paths: list[str], root: str) -> dict[str, str]:
     return out
 
 
-def run_ast_engine(files: dict[str, str]) -> list[Finding]:
+def run_ast_engine(files: dict[str, str],
+                   concurrency: bool = False) -> list[Finding]:
     """Engine 1 over {relpath: source}: AST rules + guarded-by (one shared
-    parse), with da:allow suppressions applied."""
+    parse), optionally engine 3 (``concurrency=True``), with da:allow
+    suppressions applied ONCE over the pooled findings — so a single
+    comment can cover rules from either engine, and an unused-suppression
+    is only reported for rules this run actually evaluated."""
     from .ast_rules import parse_files
 
     trees = parse_files(files)
     findings = analyze_modules(files, trees)
     for path, src in sorted(files.items()):
         findings.extend(check_guarded_by(path, src, trees[path]))
+    unchecked = frozenset()
+    if concurrency:
+        findings.extend(run_concurrency_engine(files, trees))
+    else:
+        unchecked = frozenset(CONCURRENCY_RULES)
     sups = {path: load_suppressions(src) for path, src in files.items()}
-    findings = apply_suppressions(findings, sups)
+    findings = apply_suppressions(findings, sups, unchecked_rules=unchecked)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     fingerprint_findings(findings)
     return findings
@@ -112,6 +122,41 @@ def _render_text(new, accepted, stale, *, out=sys.stdout) -> None:
     )
 
 
+def _gh_escape(s: str, *, prop: bool = False) -> str:
+    # workflow-command data escaping per the Actions toolkit: %, CR, LF
+    # always; property values additionally ':' and ','
+    s = s.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+    if prop:
+        s = s.replace(":", "%3A").replace(",", "%2C")
+    return s
+
+
+def _render_github(new, accepted, stale, *, out=sys.stdout) -> None:
+    """GitHub workflow-command annotations: CI renders each NEW finding
+    anchored to its file:line in the diff view.  Baselined debt is a
+    notice (visible, non-blocking), matching the exit-code contract."""
+    for f in new:
+        print(
+            f"::error file={_gh_escape(f.path, prop=True)},"
+            f"line={f.line},col={f.col},"
+            f"title={_gh_escape(f.rule, prop=True)}::"
+            + _gh_escape(f.message + (f"  fix: {f.hint}" if f.hint else "")),
+            file=out,
+        )
+    for f in accepted:
+        print(
+            f"::notice file={_gh_escape(f.path, prop=True)},"
+            f"line={f.line},title={_gh_escape(f.rule, prop=True)}::"
+            + _gh_escape(f"baselined (accepted debt): {f.message}"),
+            file=out,
+        )
+    print(
+        f"analysis: {len(new)} new, {len(accepted)} baselined, "
+        f"{len(stale)} stale",
+        file=out,
+    )
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m deepfm_tpu.analysis",
@@ -119,7 +164,11 @@ def main(argv: list[str] | None = None) -> int:
     )
     ap.add_argument("paths", nargs="*", default=["deepfm_tpu"],
                     help="files/directories to analyze (default: deepfm_tpu)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "github"),
+                    default="text",
+                    help="github = workflow-command annotations "
+                         "(::error file=...) so CI anchors findings to "
+                         "file:line")
     ap.add_argument("--baseline", default=None,
                     help=f"baseline JSON (default: ./{DEFAULT_BASELINE} "
                          f"when present)")
@@ -128,6 +177,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--trace-audit", action="store_true",
                     help="also run the trace-time contract audit (engine 2; "
                          "imports jax)")
+    ap.add_argument("--concurrency", action="store_true",
+                    help="also run the interprocedural concurrency engine "
+                         "(engine 3; parse-only): lock-order cycles, "
+                         "blocking-under-lock, signal-handler safety, "
+                         "thread lifecycle")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -139,7 +193,7 @@ def main(argv: list[str] | None = None) -> int:
     try:
         root = _find_root(args.paths or ["deepfm_tpu"])
         files = _collect_files(args.paths or ["deepfm_tpu"], root)
-        findings = run_ast_engine(files)
+        findings = run_ast_engine(files, concurrency=args.concurrency)
     except (OSError, ValueError) as e:
         # unanalyzable input (missing/unreadable path, syntax error) is an
         # exit-2 analyzer failure, never conflated with exit-1 findings
@@ -238,6 +292,8 @@ def main(argv: list[str] | None = None) -> int:
             sys.stdout, indent=2,
         )
         print()
+    elif args.format == "github":
+        _render_github(new, accepted, stale)
     else:
         _render_text(new, accepted, stale)
     return 1 if new else 0

@@ -54,6 +54,29 @@ RULES = {
         "the class is mutated outside any lock-held region — data race "
         "with the thread that honors the lock"
     ),
+    # concurrency (engine 3, --concurrency) rules
+    "lock-order-cycle": (
+        "two locks are acquired in opposite orders on different paths (or "
+        "a non-reentrant lock is re-acquired while held) — a potential "
+        "deadlock the thread scheduler will eventually find"
+    ),
+    "blocking-under-lock": (
+        "a blocking operation (HTTP, object-store verb, sleep, subprocess, "
+        "blocking queue get/put, file I/O, join/wait, device dispatch) is "
+        "reached — possibly through helper calls — while a lock is held; "
+        "every contending thread stalls behind it"
+    ),
+    "signal-unsafe-lock": (
+        "a function registered as a signal handler / preemption stop-"
+        "callback / excepthook acquires a non-reentrant lock also taken on "
+        "normal paths — a signal landing while the main thread holds it "
+        "deadlocks the handler"
+    ),
+    "thread-lifecycle": (
+        "a started thread with no join/stop path in its owning scope, a "
+        "fire-and-forget non-daemon thread, or a daemon thread owning "
+        "durable state — leaked on shutdown or killed mid-write"
+    ),
     "suppression-missing-reason": (
         "da:allow[...] suppression without a one-line justification"
     ),
@@ -159,10 +182,17 @@ def load_suppressions(src: str) -> list[Suppression]:
 
 
 def apply_suppressions(
-    findings: list[Finding], by_path: dict[str, list[Suppression]]
+    findings: list[Finding], by_path: dict[str, list[Suppression]],
+    unchecked_rules: frozenset[str] = frozenset(),
 ) -> list[Finding]:
     """Drop findings covered by a same-line or line-above ``da:allow``;
-    emit a finding for any suppression lacking a justification."""
+    emit a finding for any suppression lacking a justification.
+
+    ``unchecked_rules`` names real rules THIS run did not evaluate: a
+    suppression whose every rule is in that set is left alone rather than
+    reported unused — a ``da:allow[blocking-under-lock]`` comment must
+    not read as dead in a run without ``--concurrency``.  (A misspelled
+    rule name is in no engine's set, so it still reports.)"""
     kept: list[Finding] = []
     for f in findings:
         sups = by_path.get(f.path, [])
@@ -192,7 +222,8 @@ def apply_suppressions(
                     hint="write WHY the finding is acceptable, not that it is",
                     source=f"da:allow[{','.join(s.rules)}]",
                 ))
-            elif not s.used:
+            elif not s.used and any(
+                    r not in unchecked_rules for r in s.rules):
                 # unlike stale BASELINE entries (non-fatal: regenerated),
                 # a dead inline comment is immediately actionable — delete
                 # it, or it silently swallows the next same-rule finding

@@ -81,6 +81,43 @@ def _collect_accesses(
     calls: list[tuple[str, bool]] = []
     mname = method.name
 
+    def _ar_verb(st: ast.stmt) -> str | None:
+        """'acquire'/'release' for a bare ``self.<lock>.acquire()`` /
+        ``.release()`` expression statement, else None."""
+        if (isinstance(st, ast.Expr) and isinstance(st.value, ast.Call)
+                and isinstance(st.value.func, ast.Attribute)
+                and st.value.func.attr in ("acquire", "release")
+                and (_self_attr(st.value.func.value) or "") in locks):
+            return st.value.func.attr
+        return None
+
+    def visit_body(stmts: list[ast.stmt], held: bool) -> None:
+        """Statement sequence in order: ``self._lock.acquire()`` opens a
+        held region that a later ``release()`` — including one in the
+        ``finally`` of the canonical acquire/try/finally pair — closes."""
+        ar = 0  # acquire() depth opened within THIS sequence
+        for st in stmts:
+            verb = _ar_verb(st)
+            if verb == "acquire":
+                ar += 1
+                continue
+            if verb == "release":
+                ar = max(0, ar - 1)
+                continue
+            h = held or ar > 0
+            if isinstance(st, ast.Try) and ar > 0:
+                visit_body(st.body, h)
+                for hd in st.handlers:
+                    visit_body(hd.body, h)
+                visit_body(st.orelse, h)
+                visit_body(st.finalbody, h)
+                # `finally: self._lock.release()` ends the region for
+                # whatever follows the try statement
+                if any(_ar_verb(x) == "release" for x in st.finalbody):
+                    ar -= 1
+                continue
+            visit(st, h)
+
     def visit(node: ast.AST, held: bool) -> None:
         if isinstance(node, ast.With):
             item_locks = any(
@@ -89,12 +126,31 @@ def _collect_accesses(
             )
             for it in node.items:
                 visit(it.context_expr, held)
-            for b in node.body:
-                visit(b, held or item_locks)
+            visit_body(node.body, held or item_locks)
             return
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                 and node is not method:
             return  # nested defs run later / elsewhere; heldness unknown
+        if isinstance(node, ast.Try):
+            visit_body(node.body, held)
+            for h in node.handlers:
+                visit_body(h.body, held)
+            visit_body(node.orelse, held)
+            visit_body(node.finalbody, held)
+            return
+        if isinstance(node, ast.If):
+            visit(node.test, held)
+            visit_body(node.body, held)
+            visit_body(node.orelse, held)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.stmt):
+                    continue
+                visit(child, held)
+            visit_body(node.body, held)
+            visit_body(node.orelse, held)
+            return
         if isinstance(node, ast.Delete):
             # del self.attr / del self.attr[k]: a mutation like any other
             for t in node.targets:
@@ -160,8 +216,7 @@ def _collect_accesses(
         for child in ast.iter_child_nodes(node):
             visit(child, held)
 
-    for stmt in method.body:
-        visit(stmt, False)
+    visit_body(method.body, False)
     return accesses, calls
 
 

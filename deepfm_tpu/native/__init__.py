@@ -68,6 +68,7 @@ def _load() -> ctypes.CDLL:
             raise RuntimeError(_build_error)
         try:
             if _needs_build():
+                # da:allow[blocking-under-lock] build-once lazy init: the lock exists to make the slow compile happen exactly once; callers blocking behind it is the design
                 _build()
             lib = ctypes.CDLL(_LIB)
         except Exception as e:  # remember failure; don't retry per call

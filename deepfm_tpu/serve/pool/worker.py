@@ -359,6 +359,7 @@ class GroupMember:
                     # block before release (serve/reload.py): the
                     # generation must not drain while the executable is
                     # still running
+                    # da:allow[blocking-under-lock] _dispatch_lock exists to serialize device dispatch (one multi-device program on the executor at a time); the wait IS the lock's purpose
                     jax.block_until_ready(out)
                 return out
             finally:

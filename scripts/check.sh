@@ -1,9 +1,12 @@
 #!/usr/bin/env bash
 # Static-analysis gate: ruff (mechanical, skips gracefully when absent —
 # scripts/lint.sh) + the JAX-aware analyzer (deepfm_tpu/analysis: AST rules
-# incl. the guarded-by race lint, plus the trace-time contract audit), both
-# ratcheted against analysis_baseline.json — new findings exit non-zero,
-# baselined debt does not.  Usage: scripts/check.sh [--json]
+# incl. the guarded-by race lint, the interprocedural concurrency engine
+# (lock-order cycles / blocking-under-lock / signal safety / thread
+# lifecycle), plus the trace-time contract audit), all ratcheted against
+# analysis_baseline.json — new findings exit non-zero, baselined debt does
+# not (the concurrency rules ratchet at ZERO accepted debt: the baseline
+# holds no entry for them).  Usage: scripts/check.sh [--json|--github]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -12,6 +15,9 @@ scripts/lint.sh
 fmt="text"
 if [[ "${1:-}" == "--json" ]]; then
     fmt="json"
+elif [[ "${1:-}" == "--github" || -n "${GITHUB_ACTIONS:-}" ]]; then
+    # workflow-command annotations: CI anchors each finding to file:line
+    fmt="github"
 fi
 
 # Slow gate (CHECK_SLOW=1 or --slow): the elastic chaos drills — (1) kill
@@ -118,4 +124,5 @@ fi
 exec env JAX_PLATFORMS=cpu \
     XLA_FLAGS="${XLA_FLAGS:+$XLA_FLAGS }--xla_force_host_platform_device_count=8" \
     python -m deepfm_tpu.analysis deepfm_tpu \
-    --trace-audit --format "$fmt" --baseline analysis_baseline.json
+    --trace-audit --concurrency --format "$fmt" \
+    --baseline analysis_baseline.json

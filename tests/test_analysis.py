@@ -828,6 +828,582 @@ class TestRepoIsClean:
         assert new == [], "\n".join(f.render() for f in new)
 
 
+# ---------------------------------------------------------------- engine 3
+
+def canalyze(src, path: str = "mod.py"):
+    """Engine 1 + engine 3 over one in-memory module (or a {path: src}
+    dict for cross-module cases)."""
+    files = {path: src} if isinstance(src, str) else src
+    return run_ast_engine(files, concurrency=True)
+
+
+class TestBlockingUnderLock:
+    def test_sleep_under_lock_caught(self):
+        f = canalyze(
+            "import threading, time\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            time.sleep(1)\n"
+        )
+        assert "blocking-under-lock" in rules_of(f)
+        assert any("time.sleep" in x.message for x in f)
+
+    def test_sleep_outside_lock_clean(self):
+        f = canalyze(
+            "import threading, time\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            n = 1\n"
+            "        time.sleep(1)\n"
+        )
+        assert "blocking-under-lock" not in rules_of(f)
+
+    def test_helper_http_reached_under_lock_caught(self):
+        # interprocedural: the blocking op lives in a helper; the lock is
+        # held at the CALL site
+        f = canalyze(
+            "import threading\n"
+            "from urllib.request import urlopen\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def _fetch(self):\n"
+            "        return urlopen('http://x').read()\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            self._fetch()\n"
+        )
+        hits = [x for x in f if x.rule == "blocking-under-lock"]
+        assert hits and any("_fetch" in x.message for x in hits)
+        # the finding anchors at the held call site, not the helper
+        assert hits[0].line == 10
+
+    def test_cross_module_store_call_under_lock_caught(self):
+        f = canalyze({
+            "pkg/__init__.py": "",
+            "pkg/store.py": (
+                "import os\n"
+                "def list_versions(root):\n"
+                "    return os.listdir(root)\n"
+            ),
+            "pkg/user.py": (
+                "import threading\n"
+                "from .store import list_versions\n"
+                "class A:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "    def f(self):\n"
+                "        with self._lock:\n"
+                "            return list_versions('/x')\n"
+            ),
+        })
+        hits = [x for x in f if x.rule == "blocking-under-lock"]
+        assert hits and hits[0].path == "pkg/user.py"
+
+    def test_export_lock_idiom_blessed(self):
+        # a lock NAMED for serializing I/O is the sanctioned Tracer idiom
+        f = canalyze(
+            "import threading\n"
+            "class T:\n"
+            "    def __init__(self):\n"
+            "        self._export_lock = threading.Lock()\n"
+            "    def export(self):\n"
+            "        with self._export_lock:\n"
+            "            open('/tmp/x', 'w').write('y')\n"
+        )
+        assert "blocking-under-lock" not in rules_of(f)
+
+    def test_nonblocking_queue_get_clean(self):
+        f = canalyze(
+            "import threading, queue\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._q = queue.Queue()\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            return self._q.get_nowait()\n"
+        )
+        assert "blocking-under-lock" not in rules_of(f)
+
+    def test_blocking_queue_get_under_lock_caught(self):
+        f = canalyze(
+            "import threading, queue\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._q = queue.Queue()\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            return self._q.get(timeout=1)\n"
+        )
+        assert "blocking-under-lock" in rules_of(f)
+
+    def test_acquire_release_region_counts_as_held(self):
+        f = canalyze(
+            "import threading, time\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def f(self):\n"
+            "        self._lock.acquire()\n"
+            "        try:\n"
+            "            time.sleep(1)\n"
+            "        finally:\n"
+            "            self._lock.release()\n"
+        )
+        assert "blocking-under-lock" in rules_of(f)
+
+    def test_condition_wait_releases_own_lock(self):
+        # cv.wait() drops the condition's lock while blocked — the
+        # canonical consumer loop is clean
+        f = canalyze(
+            "import threading\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self._cv = threading.Condition()\n"
+            "    def f(self):\n"
+            "        with self._cv:\n"
+            "            while True:\n"
+            "                self._cv.wait()\n"
+        )
+        assert "blocking-under-lock" not in rules_of(f)
+
+
+class TestLockOrderCycle:
+    TWO_LOCK_CYCLE = (
+        "import threading\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def f(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+        "    def g(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n"
+    )
+
+    def test_opposite_order_caught_on_both_edges(self):
+        f = canalyze(self.TWO_LOCK_CYCLE)
+        hits = [x for x in f if x.rule == "lock-order-cycle"]
+        assert len(hits) == 2
+        assert {x.line for x in hits} == {8, 12}
+
+    def test_consistent_order_clean(self):
+        f = canalyze(
+            "import threading\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "    def f(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n"
+            "    def g(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n"
+        )
+        assert "lock-order-cycle" not in rules_of(f)
+
+    def test_self_deadlock_through_helper_caught(self):
+        # f holds the plain Lock and calls g, which takes it again —
+        # certain deadlock, visible only interprocedurally
+        f = canalyze(
+            "import threading\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def g(self):\n"
+            "        with self._lock:\n"
+            "            pass\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            self.g()\n"
+        )
+        hits = [x for x in f if x.rule == "lock-order-cycle"]
+        assert hits and "self-deadlock" in hits[0].message
+
+    def test_rlock_reentry_clean(self):
+        f = canalyze(
+            "import threading\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.RLock()\n"
+            "    def g(self):\n"
+            "        with self._lock:\n"
+            "            pass\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            self.g()\n"
+        )
+        assert "lock-order-cycle" not in rules_of(f)
+
+    def test_cross_class_cycle_through_calls_caught(self):
+        # A.f holds A's lock and calls B.g (acquires B's lock); B.h holds
+        # B's lock and calls back into A.k (acquires A's lock)
+        f = canalyze(
+            "import threading\n"
+            "class B:\n"
+            "    def __init__(self, a: 'A'):\n"
+            "        self._block = threading.Lock()\n"
+            "        self._a = a\n"
+            "    def g(self):\n"
+            "        with self._block:\n"
+            "            pass\n"
+            "    def h(self):\n"
+            "        with self._block:\n"
+            "            self._a.k()\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self._alock = threading.Lock()\n"
+            "        self._b = B(self)\n"
+            "    def k(self):\n"
+            "        with self._alock:\n"
+            "            pass\n"
+            "    def f(self):\n"
+            "        with self._alock:\n"
+            "            self._b.g()\n"
+        )
+        assert "lock-order-cycle" in rules_of(f)
+
+
+class TestSignalUnsafeLock:
+    def test_plain_lock_handler_caught(self):
+        f = canalyze(
+            "import signal, threading\n"
+            "_lock = threading.Lock()\n"
+            "def handler(signum, frame):\n"
+            "    with _lock:\n"
+            "        pass\n"
+            "def normal():\n"
+            "    with _lock:\n"
+            "        pass\n"
+            "signal.signal(signal.SIGTERM, handler)\n"
+        )
+        hits = [x for x in f if x.rule == "signal-unsafe-lock"]
+        assert hits and "handler" in hits[0].message
+
+    def test_rlock_handler_clean(self):
+        # the FlightRecorder idiom: RLock makes handler re-entry safe
+        f = canalyze(
+            "import signal, threading\n"
+            "_lock = threading.RLock()\n"
+            "def handler(signum, frame):\n"
+            "    with _lock:\n"
+            "        pass\n"
+            "def normal():\n"
+            "    with _lock:\n"
+            "        pass\n"
+            "signal.signal(signal.SIGTERM, handler)\n"
+        )
+        assert "signal-unsafe-lock" not in rules_of(f)
+
+    def test_handler_only_lock_clean(self):
+        # no normal-path acquirer -> no interleaving to deadlock with
+        f = canalyze(
+            "import signal, threading\n"
+            "_lock = threading.Lock()\n"
+            "def handler(signum, frame):\n"
+            "    with _lock:\n"
+            "        pass\n"
+            "signal.signal(signal.SIGTERM, handler)\n"
+        )
+        assert "signal-unsafe-lock" not in rules_of(f)
+
+    def test_stop_callback_through_helper_caught(self):
+        # PreemptionGuard stop-callbacks run from the signal path; the
+        # lock acquire sits one call deep
+        f = canalyze(
+            "import threading\n"
+            "class W:\n"
+            "    def __init__(self, guard):\n"
+            "        self._lock = threading.Lock()\n"
+            "        guard.register_stop_callback(self._on_stop)\n"
+            "    def _flush(self):\n"
+            "        with self._lock:\n"
+            "            pass\n"
+            "    def _on_stop(self):\n"
+            "        self._flush()\n"
+            "    def normal(self):\n"
+            "        with self._lock:\n"
+            "            pass\n"
+        )
+        assert "signal-unsafe-lock" in rules_of(f)
+
+    def test_excepthook_plain_lock_caught(self):
+        f = canalyze(
+            "import sys, threading\n"
+            "_lock = threading.Lock()\n"
+            "def hook(t, v, tb):\n"
+            "    with _lock:\n"
+            "        pass\n"
+            "def normal():\n"
+            "    with _lock:\n"
+            "        pass\n"
+            "sys.excepthook = hook\n"
+        )
+        assert "signal-unsafe-lock" in rules_of(f)
+
+    def test_lockfree_event_handler_clean(self):
+        # the sanctioned shape: the handler only sets an Event
+        f = canalyze(
+            "import signal, threading\n"
+            "_stop = threading.Event()\n"
+            "signal.signal(signal.SIGTERM, lambda s, fr: _stop.set())\n"
+        )
+        assert "signal-unsafe-lock" not in rules_of(f)
+
+
+class TestThreadLifecycle:
+    def test_started_never_joined_caught(self):
+        f = canalyze(
+            "import threading\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self._t = threading.Thread(target=self._run,\n"
+            "                                   daemon=True)\n"
+            "        self._t.start()\n"
+            "    def _run(self):\n"
+            "        pass\n"
+        )
+        hits = [x for x in f if x.rule == "thread-lifecycle"]
+        assert hits and "no stop path" in hits[0].message
+
+    def test_join_path_clean(self):
+        f = canalyze(
+            "import threading\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self._t = threading.Thread(target=self._run,\n"
+            "                                   daemon=True)\n"
+            "        self._t.start()\n"
+            "    def _run(self):\n"
+            "        pass\n"
+            "    def close(self):\n"
+            "        self._t.join(timeout=5)\n"
+        )
+        assert "thread-lifecycle" not in rules_of(f)
+
+    def test_fire_and_forget_non_daemon_caught(self):
+        f = canalyze(
+            "import threading\n"
+            "def work():\n"
+            "    pass\n"
+            "def go():\n"
+            "    threading.Thread(target=work).start()\n"
+        )
+        hits = [x for x in f if x.rule == "thread-lifecycle"]
+        assert hits and "non-daemon" in hits[0].message
+
+    def test_daemon_fire_and_forget_durable_state_caught(self):
+        # the daemon is killed mid-write at interpreter exit
+        f = canalyze(
+            "import threading\n"
+            "def work():\n"
+            "    with open('/tmp/x', 'w') as fh:\n"
+            "        fh.write('y')\n"
+            "def go():\n"
+            "    threading.Thread(target=work, daemon=True).start()\n"
+        )
+        hits = [x for x in f if x.rule == "thread-lifecycle"]
+        assert hits and "durable" in hits[0].message
+
+    def test_daemon_fire_and_forget_pure_compute_clean(self):
+        f = canalyze(
+            "import threading\n"
+            "def work():\n"
+            "    return 1 + 1\n"
+            "def go():\n"
+            "    threading.Thread(target=work, daemon=True).start()\n"
+        )
+        assert "thread-lifecycle" not in rules_of(f)
+
+
+class TestGuardedByAcquireRelease:
+    """Satellite: acquire()/try/finally-release() pairs are guarded
+    regions for BOTH engines, not just `with` blocks."""
+
+    def test_mutation_inside_pair_not_flagged_elsewhere_is(self):
+        f = analyze(
+            "import threading\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.n = 0\n"
+            "    def f(self):\n"
+            "        self._lock.acquire()\n"
+            "        try:\n"
+            "            self.n += 1\n"
+            "        finally:\n"
+            "            self._lock.release()\n"
+            "    def bad(self):\n"
+            "        self.n = 5\n"
+        )
+        hits = [x for x in f if x.rule == "guarded-by"]
+        assert len(hits) == 1 and hits[0].line == 13
+
+    def test_mutation_after_release_flagged(self):
+        f = analyze(
+            "import threading\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.n = 0\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            self.n += 1\n"
+            "    def g(self):\n"
+            "        self._lock.acquire()\n"
+            "        self.n += 1\n"
+            "        self._lock.release()\n"
+            "        self.n = 2\n"
+        )
+        hits = [x for x in f if x.rule == "guarded-by"]
+        assert len(hits) == 1 and hits[0].line == 13
+
+
+class TestConcurrencySuppressions:
+    SLEEPY = (
+        "import threading, time\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def f(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(1)  # da:allow[blocking-under-lock] "
+        "startup path, single-threaded by construction\n"
+    )
+
+    def test_da_allow_covers_concurrency_rules(self):
+        f = canalyze(self.SLEEPY)
+        assert "blocking-under-lock" not in rules_of(f)
+        assert "unused-suppression" not in rules_of(f)
+
+    def test_concurrency_suppression_not_unused_without_flag(self):
+        # a da:allow for a rule THIS run never evaluated must not read
+        # as dead — or every plain run would flag the concurrency
+        # suppressions and vice versa
+        f = run_ast_engine({"mod.py": self.SLEEPY}, concurrency=False)
+        assert "unused-suppression" not in rules_of(f)
+
+    def test_dead_concurrency_suppression_flagged_with_flag(self):
+        src = self.SLEEPY.replace("time.sleep(1)", "n = 1")
+        f = canalyze(src)
+        assert "unused-suppression" in rules_of(f)
+
+
+class TestConcurrencyCli:
+    """Seeded violations through the real CLI: each class exits 1, the
+    clean repo exits 0 (the ratcheted gate check.sh runs)."""
+
+    def _run(self, tmp_path, src, *args):
+        mod = tmp_path / "mod.py"
+        mod.write_text(src)
+        return subprocess.run(
+            [sys.executable, "-m", "deepfm_tpu.analysis", str(mod),
+             "--concurrency", *args],
+            capture_output=True, text=True, cwd=REPO,
+        )
+
+    def test_seeded_sleep_under_lock_exits_one(self, tmp_path):
+        proc = self._run(
+            tmp_path,
+            "import threading, time\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            time.sleep(30)\n",
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "blocking-under-lock" in proc.stdout
+
+    def test_seeded_two_lock_cycle_exits_one(self, tmp_path):
+        proc = self._run(tmp_path, TestLockOrderCycle.TWO_LOCK_CYCLE)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "lock-order-cycle" in proc.stdout
+
+    def test_seeded_plain_lock_signal_handler_exits_one(self, tmp_path):
+        proc = self._run(
+            tmp_path,
+            "import signal, threading\n"
+            "_lock = threading.Lock()\n"
+            "def handler(signum, frame):\n"
+            "    with _lock:\n"
+            "        pass\n"
+            "def normal():\n"
+            "    with _lock:\n"
+            "        pass\n"
+            "signal.signal(signal.SIGTERM, handler)\n",
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "signal-unsafe-lock" in proc.stdout
+
+    def test_github_format_emits_error_annotations(self, tmp_path):
+        proc = self._run(
+            tmp_path,
+            "import threading, time\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            time.sleep(30)\n",
+            "--format", "github",
+        )
+        assert proc.returncode == 1
+        line = next(l for l in proc.stdout.splitlines()
+                    if l.startswith("::error "))
+        # tmp file lives outside the repo root, so the path is relative
+        # but still ends at the analyzed module
+        assert "mod.py" in line.split(",")[0]
+        assert "title=blocking-under-lock" in line
+
+    def test_github_format_clean_exits_zero(self, tmp_path):
+        proc = self._run(tmp_path, "def f(x):\n    return x + 1\n",
+                        "--format", "github")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+class TestRepoIsConcurrencyClean:
+    """The concurrency gate over the real package IS a tier-1 test, and
+    it ratchets at ZERO accepted debt: the baseline holds no entry for
+    any engine-3 rule."""
+
+    def test_package_clean_under_concurrency_engine(self):
+        files = {}
+        for dirpath, dirnames, names in os.walk(
+                os.path.join(REPO, "deepfm_tpu")):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for n in names:
+                if n.endswith(".py"):
+                    full = os.path.join(dirpath, n)
+                    rel = os.path.relpath(full, REPO).replace(os.sep, "/")
+                    with open(full, encoding="utf-8") as f:
+                        files[rel] = f.read()
+        findings = run_ast_engine(files, concurrency=True)
+        baseline = load_baseline(os.path.join(REPO, "analysis_baseline.json"))
+        from deepfm_tpu.analysis import CONCURRENCY_RULES
+        assert not any(e.get("rule") in CONCURRENCY_RULES
+                       for e in baseline.values()), \
+            "engine-3 debt must be fixed or da:allow'd inline, never baselined"
+        new, _accepted, _stale = partition(findings, baseline)
+        assert new == [], "\n".join(f.render() for f in new)
+
+
 # ---------------------------------------------------------------- engine 2
 
 class TestTraceAudit:
