@@ -533,6 +533,27 @@ def main() -> None:
         import multiregion
 
         sys.exit(multiregion.main())
+    if len(sys.argv) > 1 and sys.argv[1] == "--funnel":
+        # the recommendation-funnel gate (benchmarks/funnel.py): naive
+        # loop vs fused engine vs pool, plus the exact/int8/int8+pallas
+        # retrieval-mode comparison at flagship V AND a synthetic 2e6-row
+        # corpus — FAILS (exit 1) unless the fused engine beats the naive
+        # loop and, at the synthetic corpus, int8 (or int8+pallas) makes
+        # >= 1.5x exact candidates/s with recall@K >= min_recall vs
+        # brute_force_topk.  Emits docs/BENCH_FUNNEL.json.  CPU virtual
+        # mesh by design off-TPU; on a TPU backend the int8+pallas row
+        # measures the fused Pallas kernel (kernel_engaged=true).
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        sys.argv = [sys.argv[0], "--persist"] + sys.argv[2:]
+        import funnel
+
+        r = funnel.main()
+        sys.exit(0 if r["ok"] else 1)
     if len(sys.argv) > 1 and sys.argv[1] == "--slo":
         # the SLO control-plane gate (benchmarks/slo_control.py): one
         # diurnal + 10x-spike trace against a static 2-group pool vs the

@@ -107,6 +107,13 @@ RULES = {
         "overflow fallback in alltoall mode, or a blind detector in psum "
         "mode (parallel/embedding.py shard_exchange)"
     ),
+    "trace-quantized": (
+        "the int8 retrieval lowering voids the quantized tier's "
+        "bandwidth contract: an op RESULT materializes a corpus-sized "
+        "f32 tensor (only tile-sized f32 may ever be live), or a gather "
+        "produces a corpus-sized result (only the oversampled shortlist "
+        "may be gathered for the exact rescore)"
+    ),
     "trace-observability": (
         "observability instrumentation leaked into lowered code: a host "
         "callback (registry/trace call) in the jitted graph, or a "

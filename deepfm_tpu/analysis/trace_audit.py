@@ -1202,7 +1202,7 @@ _FUNNEL_K = 8
 _FUNNEL_N = 4
 
 
-def _funnel_audit_ctx(mesh):
+def _funnel_audit_ctx(mesh, retrieval: str = "exact"):
     from ..funnel.index import make_funnel_context
 
     rank_cfg = _audit_cfg()
@@ -1211,13 +1211,99 @@ def _funnel_audit_ctx(mesh):
         "user_field_size": 4, "item_field_size": 4,
         "tower_layers": (32,), "tower_dim": 16, "embedding_size": 8,
     })
+    extra = {}
+    if retrieval == "int8":
+        # a scan tile that collides with no corpus dim (capacity 96,
+        # per-shard 48/24 on the audited meshes): the per-tile dequant
+        # [tile, D] f32 must be distinguishable from a whole-corpus one
+        extra = dict(oversample=2, retrieval_tile=16, pallas="off")
     return make_funnel_context(
         rank_cfg, query_cfg, mesh,
         capacity=_FUNNEL_CAPACITY, top_k=_FUNNEL_K, return_n=_FUNNEL_N,
+        retrieval=retrieval, **extra,
     )
 
 
-def audit_funnel(cfg=None, retrieve_builder=None) -> list[Finding]:
+def _op_result_types(line: str) -> list[str]:
+    """The result tensor type(s) of one StableHLO op line: the types
+    after the LAST ``->`` (function-type annotations), or the single
+    trailing type for ops annotated ``: tensor<...>``."""
+    import re
+
+    if "->" in line:
+        tail = line.rsplit("->", 1)[1]
+    elif " : " in line and "=" in line:
+        tail = line.rsplit(" : ", 1)[1]
+    else:
+        return []
+    return re.findall(r"tensor<([^>]*)>", tail)
+
+
+def _dims_of(tensor_type: str) -> tuple[list[int], str] | None:
+    """``"24x16xf32" -> ([24, 16], "f32")``; None for non-static shapes
+    (scalars have no dims and parse to ``([], dtype)``)."""
+    parts = tensor_type.split("x")
+    dims: list[int] = []
+    for p in parts[:-1]:
+        if not p.isdigit():
+            return None
+        dims.append(int(p))
+    return dims, parts[-1]
+
+
+# partitioning plumbing whose results legitimately carry full-corpus
+# types: the global->per-shard reshape custom_calls and the shard_map
+# argument threading
+_SHARDING_MARKERS = ("@Sharding", "@SPMDFullToShardShape",
+                    "@SPMDShardToFullShape")
+
+
+def _corpus_f32_results(text: str, corpus_dims: set[int]) -> list[str]:
+    """Lines whose op RESULT is an f32 tensor carrying a corpus-sized
+    dimension.  Function signatures and the sharding custom_calls are
+    exempt (the f32 item_emb legitimately ENTERS as an argument — the
+    contract is that the int8 scorer never computes with it at corpus
+    width, only through shortlist-sized gathers)."""
+    bad = []
+    for ln in text.splitlines():
+        s = ln.strip()
+        if (s.startswith("func.func")
+                or any(m in s for m in _SHARDING_MARKERS)):
+            continue
+        for t in _op_result_types(s):
+            parsed = _dims_of(t)
+            if parsed is None:
+                continue
+            dims, dtype = parsed
+            if dtype == "f32" and any(d in corpus_dims for d in dims):
+                bad.append(s.split(" : ")[0][:100])
+                break
+    return bad
+
+
+def _corpus_gather_results(text: str, corpus_dims: set[int]) -> list[str]:
+    """Gather ops whose RESULT carries a corpus-sized dimension — the
+    rescore must gather [B, K*oversample, D] shortlists, never anything
+    corpus-wide."""
+    bad = []
+    for ln in text.splitlines():
+        s = ln.strip()
+        if "stablehlo.gather" not in s and "stablehlo.dynamic_gather" \
+                not in s:
+            continue
+        for t in _op_result_types(s):
+            parsed = _dims_of(t)
+            if parsed is None:
+                continue
+            dims, _ = parsed
+            if any(d in corpus_dims for d in dims):
+                bad.append(s.split(" : ")[0][:100])
+                break
+    return bad
+
+
+def audit_funnel(cfg=None, retrieve_builder=None,
+                 modes=None) -> list[Finding]:
     """The recommendation funnel's lowering contract
     (funnel/index.py), on every audited serve mesh:
 
@@ -1242,9 +1328,23 @@ def audit_funnel(cfg=None, retrieve_builder=None) -> list[Finding]:
       to identical signatures and modules: an index/weights republish
       can never recompile mid-traffic.
 
+    The int8 retrieval mode (``funnel_retrieval``, funnel/quant.py) is
+    audited alongside exact with two additional lowering checks:
+
+    * **no corpus-sized f32 result** — no op in the int8 retrieve may
+      MATERIALIZE an f32 tensor with a corpus dimension: scoring streams
+      int8 tiles and dequantizes tile-by-tile, so the largest live f32
+      is tile-sized (the bandwidth saving IS the contract);
+    * **no corpus-sized gather** — the exact rescore gathers only the
+      [B, K*oversample, D] shortlist from the f32 rows; a gather whose
+      result is corpus-sized re-reads what quantization saved.
+
     ``retrieve_builder(ctx)`` lets the seeded-violation tests feed a
-    contract-breaking retrieve (full-score gather, baked index) through
-    the same checks."""
+    contract-breaking retrieve (full-score gather, baked index,
+    whole-corpus dequantize, corpus-wide rescore gather) through the
+    same checks; ``modes`` restricts which retrieval modes are audited
+    (default: exact + int8 for the real builder, exact only for a
+    seeded one — violation builders target one mode's payload tree)."""
     import sys
 
     import jax
@@ -1267,11 +1367,19 @@ def audit_funnel(cfg=None, retrieve_builder=None) -> list[Finding]:
 
     where = "deepfm_tpu/funnel/index.py"
     builder = retrieve_builder or build_retrieve_with
+    if modes is None:
+        # a seeded violation builder targets ONE mode's payload tree;
+        # default it to exact (the pre-existing seeded tests) and let
+        # int8-violation tests pass modes=("int8",) explicitly
+        modes = ("exact",) if retrieve_builder is not None \
+            else ("exact", "int8")
     out: list[Finding] = []
     buckets = _default_buckets()
     for dp, mp in _FUNNEL_AUDIT_MESHES:
-        mesh = build_serve_mesh(dp, mp)
-        ctx = _funnel_audit_ctx(mesh)
+      mesh = build_serve_mesh(dp, mp)
+      for mode in modes:
+        ctx = _funnel_audit_ctx(mesh, mode)
+        tag = f"{dp}x{mp}" if mode == "exact" else f"{dp}x{mp}-{mode}"
         payload = abstract_funnel_payload(ctx)
         retrieve_with = builder(ctx)
         rank_with = build_rank_topn_with(ctx)
@@ -1312,11 +1420,11 @@ def audit_funnel(cfg=None, retrieve_builder=None) -> list[Finding]:
             out.append(_finding(
                 "trace-transfer",
                 f"lowering the funnel executables on mesh [{dp},{mp}] "
-                f"under transfer_guard('disallow') raised "
+                f"({mode}) under transfer_guard('disallow') raised "
                 f"{type(e).__name__}: {e}",
                 hint="queries, ranking rows, weights and the index must "
                      "enter through arguments (funnel/index.py)",
-                where=where, slug=f"funnel-{dp}x{mp}-transfer-guard",
+                where=where, slug=f"funnel-{tag}-transfer-guard",
             ))
             continue
         b0 = max(buckets)
@@ -1325,12 +1433,12 @@ def audit_funnel(cfg=None, retrieve_builder=None) -> list[Finding]:
         if "top_k" not in text:
             out.append(_finding(
                 "trace-collective",
-                f"funnel retrieve on mesh [{dp},{mp}] lowered WITHOUT a "
-                f"top_k selection — candidates are not reduced per shard "
-                f"before the merge",
+                f"funnel retrieve on mesh [{dp},{mp}] ({mode}) lowered "
+                f"WITHOUT a top_k selection — candidates are not reduced "
+                f"per shard before the merge",
                 hint="per-shard lax.top_k then candidate-pack all_gather "
                      "(funnel/index.build_retrieve_with)",
-                where=where, slug=f"funnel-{dp}x{mp}-topk-missing",
+                where=where, slug=f"funnel-{tag}-topk-missing",
             ))
         # no collective may move a corpus-sized operand
         corpus_dims = {_FUNNEL_CAPACITY, _FUNNEL_CAPACITY // mp}
@@ -1341,15 +1449,45 @@ def audit_funnel(cfg=None, retrieve_builder=None) -> list[Finding]:
         if bad:
             out.append(_finding(
                 "trace-collective",
-                f"funnel retrieve on mesh [{dp},{mp}] moves a "
+                f"funnel retrieve on mesh [{dp},{mp}] ({mode}) moves a "
                 f"corpus-sized tensor through a collective: "
                 f"{[(c['op'], c['shapes']) for c in bad]} (corpus dims "
                 f"{sorted(corpus_dims)}) — only the [B_local, K] "
                 f"candidate packs may cross the wire",
                 hint="score and top-k per shard; gather candidate packs, "
                      "never the score tensor (funnel/index.py)",
-                where=where, slug=f"funnel-{dp}x{mp}-corpus-gather",
+                where=where, slug=f"funnel-{tag}-corpus-gather",
             ))
+        if ctx.retrieval_mode == "int8":
+            # the quantized tier's bandwidth contract: int8 streams,
+            # tile-sized f32, shortlist-sized rescore gathers only
+            bad_f32 = _corpus_f32_results(text, corpus_dims)
+            if bad_f32:
+                out.append(_finding(
+                    "trace-quantized",
+                    f"int8 funnel retrieve on mesh [{dp},{mp}] "
+                    f"materializes corpus-sized f32 results: "
+                    f"{bad_f32[:3]} (corpus dims {sorted(corpus_dims)}) "
+                    f"— the quantized scorer must stream int8 tiles and "
+                    f"hold only tile-sized f32",
+                    hint="dequantize per scan tile "
+                         "(ops/pallas_retrieval.score_topk_tiles); never "
+                         "codes.astype(f32) over the whole shard",
+                    where=where, slug=f"funnel-{tag}-corpus-f32",
+                ))
+            bad_gather = _corpus_gather_results(text, corpus_dims)
+            if bad_gather:
+                out.append(_finding(
+                    "trace-quantized",
+                    f"int8 funnel retrieve on mesh [{dp},{mp}] gathers "
+                    f"a corpus-sized result: {bad_gather[:3]} (corpus "
+                    f"dims {sorted(corpus_dims)}) — the exact rescore "
+                    f"may gather only the [B, K*oversample, D] "
+                    f"shortlist",
+                    hint="jnp.take the shortlist rows only "
+                         "(funnel/index.build_retrieve_with int8 branch)",
+                    where=where, slug=f"funnel-{tag}-rescore-gather",
+                ))
         # payload leaves (incl. the index) must be lowered PARAMETERS
         n_payload = len(jax.tree_util.tree_leaves(payload))
         for name, lo, extra in (("retrieve", lowered_q[b0], 2),
@@ -1358,13 +1496,14 @@ def audit_funnel(cfg=None, retrieve_builder=None) -> list[Finding]:
             if n_in != n_payload + extra:
                 out.append(_finding(
                     "trace-recompile",
-                    f"funnel {name} on mesh [{dp},{mp}] has {n_in} input "
-                    f"leaves, expected {n_payload} payload leaves + "
-                    f"{extra} — weights or the index were baked in as "
-                    f"constants (every index refresh would recompile)",
+                    f"funnel {name} on mesh [{dp},{mp}] ({mode}) has "
+                    f"{n_in} input leaves, expected {n_payload} payload "
+                    f"leaves + {extra} — weights or the index were baked "
+                    f"in as constants (every index refresh would "
+                    f"recompile)",
                     hint="pass the combined funnel payload as an argument "
                          "(funnel/index.py)",
-                    where=where, slug=f"funnel-{dp}x{mp}-{name}-baked",
+                    where=where, slug=f"funnel-{tag}-{name}-baked",
                 ))
         # refresh == cache hit: a same-spec replacement payload must
         # lower identically
@@ -1374,22 +1513,23 @@ def audit_funnel(cfg=None, retrieve_builder=None) -> list[Finding]:
         if lowered_q[b1].in_avals != lo2.in_avals:
             out.append(_finding(
                 "trace-recompile",
-                f"funnel retrieve on mesh [{dp},{mp}]: a same-spec "
-                f"replacement payload changed the input signature — an "
-                f"index/weights republish would MISS the jit cache and "
-                f"recompile mid-traffic",
+                f"funnel retrieve on mesh [{dp},{mp}] ({mode}): a "
+                f"same-spec replacement payload changed the input "
+                f"signature — an index/weights republish would MISS the "
+                f"jit cache and recompile mid-traffic",
                 hint="keep the payload a plain argument pytree "
                      "(funnel/index.build_retrieve_with)",
-                where=where, slug=f"funnel-{dp}x{mp}-swap-signature",
+                where=where, slug=f"funnel-{tag}-swap-signature",
             ))
         elif lowered_q[b1].as_text() != lo2.as_text():
             out.append(_finding(
                 "trace-recompile",
-                f"funnel retrieve on mesh [{dp},{mp}]: same-spec payloads "
-                f"lowered to different modules — payload identity (a "
-                f"version) leaked into the executable",
+                f"funnel retrieve on mesh [{dp},{mp}] ({mode}): "
+                f"same-spec payloads lowered to different modules — "
+                f"payload identity (a version) leaked into the "
+                f"executable",
                 hint="no host reads of the payload inside the retrieve",
-                where=where, slug=f"funnel-{dp}x{mp}-swap-module",
+                where=where, slug=f"funnel-{tag}-swap-module",
             ))
     return out
 

@@ -958,6 +958,24 @@ class RunConfig:
     # engages the funnel geometry validation in Config.__post_init__.
     funnel_top_k: int = 0
     funnel_return_n: int = 0
+    # quantized retrieval tier (funnel/quant.py): "exact" scores the f32
+    # corpus bit-exactly; "int8" streams per-row symmetric int8 codes and
+    # exactly rescores an oversampled shortlist in f32; "auto" picks int8
+    # once the index CAPACITY crosses funnel/quant.AUTO_INT8_MIN_ROWS.
+    # Not an executable-spec field, but part of the published funnel
+    # manifest — publish and serving modes must agree (stage_version
+    # refuses skew).
+    funnel_retrieval: str = "exact"
+    # int8 shortlist width multiplier: K*oversample candidates survive the
+    # quantized pass into the exact f32 rescore
+    funnel_oversample: int = 4
+    # publish-time recall gate (funnel/recall.py): an int8 publish whose
+    # measured recall@top_k falls under this is refused
+    funnel_min_recall: float = 0.95
+    # the fused Pallas score/top-k kernel (ops/pallas_retrieval.py):
+    # on | off | auto (auto = TPU backends only, with a compile-probe
+    # fallback to the lax composition)
+    funnel_pallas: str = "auto"
     # online continuous training (task_type=online-train, online/trainer.py):
     # publish a servable version every N optimizer steps (0 = only at
     # stream end); stop after N batches (0 = unbounded); stop after N
@@ -1109,6 +1127,32 @@ class Config:
         # serve mesh (funnel/index.make_funnel_context); this is the
         # config-time gate on the declared topology.
         r = self.run
+        # the quantized-tier knobs validate even without funnel_top_k —
+        # a typo'd mode string must fail the config load, not the serve
+        # boot hours later.  The literal mirrors funnel/quant.py
+        # RETRIEVAL_MODES (config stays import-light; a sync test pins
+        # the two)
+        retrieval_modes = ("exact", "int8", "auto")
+        if r.funnel_retrieval not in retrieval_modes:
+            raise ValueError(
+                f"run.funnel_retrieval={r.funnel_retrieval!r} is not one "
+                f"of {retrieval_modes}"
+            )
+        if r.funnel_pallas not in ("on", "off", "auto"):
+            raise ValueError(
+                f"run.funnel_pallas={r.funnel_pallas!r} must be "
+                f"'on', 'off' or 'auto'"
+            )
+        if r.funnel_oversample < 1:
+            raise ValueError(
+                f"run.funnel_oversample={r.funnel_oversample} must be "
+                f">= 1 (1 = no oversampling, shortlist width == top_k)"
+            )
+        if not 0.0 < r.funnel_min_recall <= 1.0:
+            raise ValueError(
+                f"run.funnel_min_recall={r.funnel_min_recall} must lie "
+                f"in (0, 1] — it gates int8 publishes"
+            )
         if r.funnel_top_k > 0:
             k = r.funnel_top_k
             if r.funnel_return_n > k:
@@ -1129,6 +1173,20 @@ class Config:
                         f"row-sharded over model_parallel={mp_serve}) — "
                         f"per-shard lax.top_k cannot select more rows than "
                         f"a shard holds"
+                    )
+                # the int8 shortlist widens the per-shard selection to
+                # K*oversample — the same pigeonhole, scaled ("auto" is
+                # checked at runtime where the capacity is known)
+                if (r.funnel_retrieval == "int8"
+                        and k * r.funnel_oversample > per_shard):
+                    raise ValueError(
+                        f"funnel_top_k*funnel_oversample = "
+                        f"{k}*{r.funnel_oversample} = "
+                        f"{k * r.funnel_oversample} exceeds the (padded) "
+                        f"per-shard item vocab {per_shard} — the int8 "
+                        f"shortlist's per-shard lax.top_k cannot select "
+                        f"more rows than a shard holds; lower "
+                        f"funnel_oversample or funnel_top_k"
                     )
             buckets = _parse_int_list(r.serve_buckets)
             if buckets:

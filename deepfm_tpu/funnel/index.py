@@ -30,6 +30,12 @@ serve/reload.py discipline, state-sharding per arxiv 2004.13336): a
 republished index with the same capacity is a jit cache hit, never a
 recompile.  Pad rows [items, capacity) carry ``item_id = -1`` and score
 ``-inf``, so they are unreturnable whenever the corpus holds >= K items.
+
+``retrieval_mode="int8"`` (funnel/quant.py + ops/pallas_retrieval.py)
+swaps the per-shard scorer for the quantized tier — stream int8 code
+tiles through a running top-(K·oversample), exact-f32-rescore the
+shortlist, reduce to K — and leaves every other stage of the diagram
+above untouched: same candidate-pack ABI, same merge, same collectives.
 """
 
 from __future__ import annotations
@@ -126,6 +132,10 @@ class FunnelContext(NamedTuple):
     rank_fields: int           # ranker feature width (F)
     payload_specs: Any         # PartitionSpec pytree for the funnel payload
     payload_shardings: Any     # NamedSharding pytree (device placement)
+    retrieval_mode: str = "exact"   # resolved: "exact" | "int8"
+    oversample: int = 1        # int8 shortlist width = top_k * oversample
+    retrieval_tile: int = 0    # int8 scan tile rows (0 = library default)
+    pallas: str = "off"        # fused-kernel knob: "on" | "off" | "auto"
 
 
 def make_funnel_context(
@@ -137,18 +147,26 @@ def make_funnel_context(
     top_k: int,
     return_n: int = 0,
     item_field: int | None = None,
+    retrieval: str = "exact",
+    oversample: int = 4,
+    retrieval_tile: int = 0,
+    pallas: str = "auto",
 ) -> FunnelContext:
     """Derive the funnel geometry + payload shardings by shape inference
     only (nothing materializes — the spmd.make_context discipline).
 
     The index shards over the mesh's ``model`` axis (``capacity`` rounds
     up to a multiple of it); query-tower and ranker weights replicate.
-    ``item_field`` defaults to the ranker's LAST field."""
+    ``item_field`` defaults to the ranker's LAST field.  ``retrieval``
+    ("exact" | "int8" | "auto") resolves here against the (padded)
+    capacity — the mode is static serving geometry, part of the payload
+    tree the executables compile for."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from ..parallel.mesh import MODEL_AXIS, mesh_shape
     from ..parallel.spmd import padded_vocab
+    from .quant import resolve_retrieval_mode
 
     dp, mp = mesh_shape(mesh)
     if capacity < 1:
@@ -169,6 +187,30 @@ def make_funnel_context(
         raise ValueError(
             f"funnel return_n={return_n} must lie in [1, top_k={top_k}]"
         )
+    mode = resolve_retrieval_mode(retrieval, capacity)
+    oversample = int(oversample) if mode == "int8" else 1
+    if oversample < 1:
+        raise ValueError(
+            f"funnel oversample must be >= 1, got {oversample}"
+        )
+    if mode == "int8" and top_k * oversample > per_shard:
+        raise ValueError(
+            f"funnel oversample={oversample} * top_k={top_k} = "
+            f"{top_k * oversample} exceeds the per-shard index rows "
+            f"{per_shard} (capacity {capacity} over model_parallel={mp}) — "
+            f"the int8 shortlist cannot select more rows than a shard "
+            f"holds; lower the oversample or the model-parallel width"
+        )
+    retrieval_tile = int(retrieval_tile)
+    if retrieval_tile < 0:
+        raise ValueError(
+            f"funnel retrieval_tile must be >= 0 (0 = default), got "
+            f"{retrieval_tile}"
+        )
+    if pallas not in ("on", "off", "auto"):
+        raise ValueError(
+            f"funnel pallas={pallas!r} is not one of ('on', 'off', 'auto')"
+        )
     f = rank_cfg.model.field_size
     item_field = f - 1 if item_field is None else int(item_field)
     if not 0 <= item_field < f:
@@ -176,8 +218,12 @@ def make_funnel_context(
             f"funnel item_field={item_field} out of the ranker's "
             f"[0, {f}) field range"
         )
-    payload_shapes = _payload_shapes(rank_cfg, query_cfg, capacity)
+    payload_shapes = _payload_shapes(rank_cfg, query_cfg, capacity,
+                                     retrieval_mode=mode)
     index_specs = {"item_ids": P(MODEL_AXIS), "item_emb": P(MODEL_AXIS, None)}
+    if mode == "int8":
+        index_specs["item_codes"] = P(MODEL_AXIS, None)
+        index_specs["item_scales"] = P(MODEL_AXIS)
     specs = {
         "query": jax.tree_util.tree_map(lambda _: P(),
                                         payload_shapes["query"]),
@@ -195,14 +241,19 @@ def make_funnel_context(
         user_fields=query_cfg.model.user_field_size,
         rank_fields=f,
         payload_specs=specs, payload_shardings=shardings,
+        retrieval_mode=mode, oversample=oversample,
+        retrieval_tile=retrieval_tile, pallas=pallas,
     )
 
 
 def _payload_shapes(rank_cfg: Config, query_cfg: Config,
-                    capacity: int) -> dict:
+                    capacity: int, retrieval_mode: str = "exact") -> dict:
     """THE funnel payload tree, as ShapeDtypeStructs — single source for
     the serving shardings (make_funnel_context) and the audit payload
-    (abstract_funnel_payload), so they cannot desynchronize."""
+    (abstract_funnel_payload), so they cannot desynchronize.  The int8
+    mode adds the code matrix + per-row scales NEXT TO the f32 rows (the
+    shortlist rescore reads those), so the mode is part of the payload
+    spec the swap-time check refuses to drift."""
     import jax
 
     from ..models.base import get_model
@@ -216,20 +267,25 @@ def _payload_shapes(rank_cfg: Config, query_cfg: Config,
         lambda: init_two_tower(jax.random.PRNGKey(0), query_cfg.model)
     )
     d = query_cfg.model.tower_dim
+    index = {
+        "item_ids": jax.ShapeDtypeStruct((capacity,), np.int32),
+        "item_emb": jax.ShapeDtypeStruct((capacity, d), np.float32),
+    }
+    if retrieval_mode == "int8":
+        index["item_codes"] = jax.ShapeDtypeStruct((capacity, d), np.int8)
+        index["item_scales"] = jax.ShapeDtypeStruct((capacity,), np.float32)
     return {
         "query": {k: tower_params[k] for k in ("user_embedding",
                                                "user_tower")},
         "rank": {"params": rank_params, "model_state": rank_state},
-        "index": {
-            "item_ids": jax.ShapeDtypeStruct((capacity,), np.int32),
-            "item_emb": jax.ShapeDtypeStruct((capacity, d), np.float32),
-        },
+        "index": index,
     }
 
 
 def abstract_funnel_payload(ctx: FunnelContext) -> dict:
     """ShapeDtypeStruct payload pytree for the lowering-only trace audit."""
-    return _payload_shapes(ctx.rank_cfg, ctx.query_cfg, ctx.capacity)
+    return _payload_shapes(ctx.rank_cfg, ctx.query_cfg, ctx.capacity,
+                           retrieval_mode=ctx.retrieval_mode)
 
 
 def build_retrieve_with(ctx: FunnelContext) -> Callable:
@@ -240,7 +296,18 @@ def build_retrieve_with(ctx: FunnelContext) -> Callable:
     Queries shard over the data axis, the index over the model axis;
     per-shard scoring + top-k, then the all-gathered candidate-pack merge
     — all inside ONE jitted function whose payload (query tower AND
-    index) rides as arguments, so an index refresh is a jit cache hit."""
+    index) rides as arguments, so an index refresh is a jit cache hit.
+
+    ``ctx.retrieval_mode`` picks the per-shard scorer.  ``"exact"`` is
+    the original full-precision matmul, unchanged (bit-parity with
+    :func:`brute_force_topk`).  ``"int8"`` streams the quantized code
+    tiles through a running top-(K·oversample) (ops/pallas_retrieval.py
+    — the lax scan, or the fused Pallas kernel when ``ctx.pallas``
+    resolves on and the compile probe passes), then re-scores ONLY the
+    shortlist rows against the exact f32 embeddings (a shortlist-sized
+    gather — never the corpus) before the unchanged candidate-pack merge:
+    the output ABI, tie order, and collective footprint are identical
+    across modes."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -252,6 +319,20 @@ def build_retrieve_with(ctx: FunnelContext) -> Callable:
 
     qcfg = ctx.query_cfg.model
     k = ctx.top_k
+
+    def merge_packs(s, grow, cid):
+        # candidate packs ONLY cross the wire: [B_local, K] each, never
+        # the [B_local, rows_local] score tensor (the audit's contract)
+        s_all = lax.all_gather(s, MODEL_AXIS, axis=1, tiled=True)
+        g_all = lax.all_gather(grow, MODEL_AXIS, axis=1, tiled=True)
+        c_all = lax.all_gather(cid, MODEL_AXIS, axis=1, tiled=True)
+        # global merge: ascending lexicographic (-score, global row) ==
+        # descending score with ties toward the earlier corpus row —
+        # brute_force_topk's np.lexsort order exactly
+        neg_s, _, c_s = lax.sort(
+            (-s_all, g_all, c_all), dimension=1, num_keys=2
+        )
+        return -neg_s[:, :k], c_s[:, :k]
 
     def local_retrieve(payload, user_ids, user_vals):
         u = encode_tower(
@@ -267,18 +348,73 @@ def build_retrieve_with(ctx: FunnelContext) -> Callable:
         rows_local = emb.shape[0]
         grow = lax.axis_index(MODEL_AXIS) * rows_local + li
         cid = jnp.take(iid, li, axis=0)
-        # candidate packs ONLY cross the wire: [B_local, K] each, never
-        # the [B_local, rows_local] score tensor (the audit's contract)
-        s_all = lax.all_gather(s, MODEL_AXIS, axis=1, tiled=True)
-        g_all = lax.all_gather(grow, MODEL_AXIS, axis=1, tiled=True)
-        c_all = lax.all_gather(cid, MODEL_AXIS, axis=1, tiled=True)
-        # global merge: ascending lexicographic (-score, global row) ==
-        # descending score with ties toward the earlier corpus row —
-        # brute_force_topk's np.lexsort order exactly
-        neg_s, _, c_s = lax.sort(
-            (-s_all, g_all, c_all), dimension=1, num_keys=2
+        return merge_packs(s, grow, cid)
+
+    if ctx.retrieval_mode == "int8":
+        from ..ops.pallas_retrieval import (
+            DEFAULT_SCAN_TILE,
+            resolve_retrieval_kernel,
+            retrieval_kernel_available,
+            retrieval_kernel_lowers,
+            retrieval_topk_kernel,
+            score_topk_tiles,
         )
-        return -neg_s[:, :k], c_s[:, :k]
+
+        kos = k * ctx.oversample
+        tile = ctx.retrieval_tile or DEFAULT_SCAN_TILE
+        use_kernel = resolve_retrieval_kernel(ctx.pallas)
+        if use_kernel:
+            from ..parallel.mesh import mesh_shape
+
+            dp, mp = mesh_shape(ctx.mesh)
+            d = ctx.query_cfg.model.tower_dim
+            # probe at the largest per-shard dispatch shape; a Mosaic
+            # gap falls back to the lax scan instead of failing the boot
+            use_kernel = retrieval_kernel_lowers(
+                1, d, ctx.capacity // mp, kos, min(tile, ctx.capacity // mp)
+            )
+        interpret = use_kernel and not retrieval_kernel_available()
+
+        def local_retrieve_int8(payload, user_ids, user_vals):
+            u = encode_tower(
+                payload["query"], user_ids, user_vals, cfg=qcfg, side="user"
+            )                                       # [B_local, D]
+            emb = payload["index"]["item_emb"]      # [rows_local, D] f32
+            iid = payload["index"]["item_ids"]      # [rows_local]
+            codes = payload["index"]["item_codes"]  # [rows_local, D] i8
+            scl = payload["index"]["item_scales"]   # [rows_local]
+            if use_kernel:
+                s_a, li = retrieval_topk_kernel(
+                    u, codes, scl, iid, kos=kos, interpret=interpret
+                )
+            else:
+                s_a, li = score_topk_tiles(
+                    u, codes, scl, iid, kos=kos, tile=tile
+                )                                   # [B_local, K*os]
+            # slots whose approximate score is -inf never saw a real row
+            # (pads, or a corpus smaller than the shortlist): their row
+            # indices are meaningless — clamp to 0 for the gather and
+            # mask the rescore, exactly like the exact path masks pads
+            valid = s_a > -jnp.inf
+            li = jnp.where(valid, li, 0)
+            cid = jnp.where(valid, jnp.take(iid, li, axis=0), -1)
+            # exact f32 rescore of the SHORTLIST rows only: the gather
+            # result is [B_local, K*os, D] — shortlist-sized, never the
+            # corpus (the audit's no-corpus-gather contract)
+            sub = jnp.take(emb, li, axis=0)
+            s = jnp.einsum("bd,bkd->bk", u, sub)
+            s = jnp.where(valid & (cid >= 0), s, -jnp.inf)
+            rows_local = emb.shape[0]
+            grow = lax.axis_index(MODEL_AXIS) * rows_local + li
+            # per-shard reduce K*os -> K under the SAME lexicographic
+            # key the global merge uses (rescored order, ties toward the
+            # smaller global row)
+            neg_s, g_s, c_s = lax.sort(
+                (-s, grow, cid), dimension=1, num_keys=2
+            )
+            return merge_packs(-neg_s[:, :k], g_s[:, :k], c_s[:, :k])
+
+        local_retrieve = local_retrieve_int8
 
     mapped = shard_map(
         local_retrieve,
@@ -292,6 +428,11 @@ def build_retrieve_with(ctx: FunnelContext) -> Callable:
     def retrieve_with(payload, user_ids, user_vals):
         return mapped(payload, user_ids, user_vals)
 
+    # observability: did the Pallas kernel actually engage (vs the lax
+    # scan fallback)?  funnel_snapshot and the bench read this.
+    retrieve_with.kernel_engaged = (
+        ctx.retrieval_mode == "int8" and use_kernel
+    )
     return retrieve_with
 
 
@@ -406,30 +547,76 @@ def stage_funnel_payload(
     ids[:n] = index.item_ids
     emb = np.zeros((ctx.capacity, d), np.float32)
     emb[:n] = index.item_emb
+    index_leaves = {"item_ids": ids, "item_emb": emb}
+    if ctx.retrieval_mode == "int8":
+        # quantize at index-build (staging) time: codes are a pure
+        # function of the f32 rows, so every staged version's codes are
+        # consistent with its rescore source by construction (pad rows
+        # quantize to scale 0 + zero codes — still exactly zero)
+        from .quant import quantize_rows
+
+        codes, scales = quantize_rows(emb)
+        index_leaves["item_codes"] = codes
+        index_leaves["item_scales"] = scales
     payload = {
         "query": {k: query_params[k] for k in ("user_embedding",
                                                "user_tower")},
         "rank": {"params": rank_params, "model_state": rank_state},
-        "index": {"item_ids": ids, "item_emb": emb},
+        "index": index_leaves,
     }
     return jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, s), payload, ctx.payload_shardings
     )
 
 
+# the candidate-pack lanes that actually cross the model-axis collective:
+# (scores f32, global rows i32, item ids i32) — per-element widths, NOT a
+# magic "3 * 4".  The pack ABI is mode-independent by design (the int8
+# tier reduces to the same packs before any collective), so the wire
+# estimate below holds for every retrieval mode; what the mode changes is
+# the per-shard score-stream traffic, which funnel_score_bytes_est prices.
+_PACK_LANE_BYTES = (4, 4, 4)
+
+
 def funnel_wire_bytes_est(ctx: FunnelContext, bucket: int) -> int:
     """Estimated collective bytes per ``bucket``-row retrieve dispatch per
-    shard: three candidate packs ([B_local, K] f32 scores + i32 rows +
-    i32 ids) all-gathered across the model axis — the observability
-    number the pool router reads, and the thing to compare against the
-    corpus bytes a score-all gather would move."""
+    shard: the candidate packs ([B_local, K] each, ``_PACK_LANE_BYTES``
+    wide) all-gathered across the model axis — the observability number
+    the pool router reads, and the thing to compare against the corpus
+    bytes a score-all gather would move."""
     import math
 
     from ..parallel.mesh import mesh_shape
 
     dp, mp = mesh_shape(ctx.mesh)
     b_local = max(1, math.ceil(bucket / max(1, dp)))
-    return 3 * 4 * b_local * ctx.top_k * mp
+    return sum(_PACK_LANE_BYTES) * b_local * ctx.top_k * mp
+
+
+def funnel_score_bytes_est(ctx: FunnelContext, bucket: int) -> dict:
+    """Memory traffic the per-shard scoring stage streams per dispatch,
+    summed over shards — the number the int8 tier exists to shrink.
+
+    ``exact`` reads the whole f32 corpus (capacity * D * 4 bytes);
+    ``int8`` reads the int8 codes + f32 row scales plus a shortlist-sized
+    f32 rescore gather.  ``saved_bytes`` is the delta against exact —
+    surfaced in the ``/v1/metrics`` funnel section and the readiness
+    probe next to ``retrieval_mode``."""
+    import math
+
+    from ..parallel.mesh import mesh_shape
+
+    dp, mp = mesh_shape(ctx.mesh)
+    d = ctx.query_cfg.model.tower_dim
+    b_local = max(1, math.ceil(bucket / max(1, dp)))
+    exact_read = ctx.capacity * d * 4
+    if ctx.retrieval_mode != "int8":
+        return {"score_read_bytes": exact_read, "saved_bytes": 0}
+    kos = ctx.top_k * ctx.oversample
+    read = (ctx.capacity * (d + 4)           # i8 codes + f32 row scale
+            + b_local * mp * kos * d * 4)    # shortlist rescore gather
+    return {"score_read_bytes": read,
+            "saved_bytes": max(0, exact_read - read)}
 
 
 def brute_force_topk(

@@ -55,8 +55,9 @@ def funnel_meta(
     index: FunnelIndex,
     user_fields: int,
     rank_fields: int,
+    retrieval: dict | None = None,
 ) -> dict:
-    return {
+    meta = {
         "item_field": int(item_field),
         "top_k": int(top_k),
         "return_n": int(return_n),
@@ -66,6 +67,65 @@ def funnel_meta(
         "user_field_size": int(user_fields),
         "rank_field_size": int(rank_fields),
     }
+    if retrieval is not None:
+        meta["retrieval"] = dict(retrieval)
+    return meta
+
+
+def resolve_retrieval_section(
+    index: FunnelIndex,
+    *,
+    capacity: int,
+    top_k: int,
+    retrieval: str = "exact",
+    oversample: int = 4,
+    min_recall: float = 0.95,
+    recall_queries: int = 256,
+) -> dict:
+    """Build the manifest/funnel.json ``retrieval`` section and ENFORCE
+    the quality gate for int8 publishes.
+
+    The mode resolves against the capacity (the same rule the serving
+    context applies — funnel/quant.resolve_retrieval_mode), the quant
+    error bound is computed from the actual rows, and the recall harness
+    (funnel/recall.py) measures recall@top_k of the quantized path
+    against ``brute_force_topk`` on the REAL corpus being published.
+    Measured recall under ``min_recall`` raises — the version is refused
+    before any byte is written."""
+    from .quant import quantization_stats, quantize_rows, \
+        resolve_retrieval_mode
+
+    mode = resolve_retrieval_mode(retrieval, capacity)
+    min_recall = float(min_recall)
+    if not 0.0 < min_recall <= 1.0:
+        raise ValueError(
+            f"funnel min_recall={min_recall} must lie in (0, 1]"
+        )
+    section = {"mode": mode, "oversample": int(oversample) if mode == "int8"
+               else 1, "min_recall": min_recall}
+    if mode != "int8":
+        return section
+    from .recall import measure_recall
+
+    codes, scales = quantize_rows(index.item_emb)
+    section.update(quantization_stats(index.item_emb, codes, scales))
+    measured = measure_recall(
+        index.item_emb, index.item_ids, int(top_k),
+        oversample=int(oversample), n_queries=int(recall_queries),
+    )
+    section["measured_recall"] = measured["recall"]
+    section["worst_query_recall"] = measured["worst_query_recall"]
+    section["recall_queries"] = measured["n_queries"]
+    if measured["recall"] < min_recall:
+        raise ValueError(
+            f"int8 retrieval recall@{top_k} = {measured['recall']:.4f} on "
+            f"this corpus falls under the min_recall gate {min_recall} "
+            f"(oversample={oversample}, worst query "
+            f"{measured['worst_query_recall']:.4f}) — refusing to publish "
+            f"a version that would degrade retrieval quality; raise the "
+            f"oversample or fix the corpus"
+        )
+    return section
 
 
 def write_funnel_tree(
@@ -158,20 +218,30 @@ def export_funnel_servable(
     top_k: int = 32,
     return_n: int = 0,
     capacity: int = 0,
+    retrieval: str = "exact",
+    oversample: int = 4,
+    min_recall: float = 0.95,
 ) -> str:
     """Write the boot funnel servable ``--task_type serve`` loads.
 
     ``capacity`` fixes the index row budget the serving executables are
     compiled for (0 = the initial corpus size); staged refreshes may grow
-    the corpus up to it without a recompile."""
+    the corpus up to it without a recompile.  ``retrieval`` / ``oversample``
+    / ``min_recall`` stamp the quantized-tier contract into funnel.json
+    (int8 exports run the recall gate — same rule as publish_funnel)."""
     f = rank_cfg.model.field_size
+    cap = capacity or index.item_ids.shape[0]
     meta = funnel_meta(
         item_field=f - 1 if item_field is None else item_field,
         top_k=top_k, return_n=return_n or top_k,
-        capacity=capacity or index.item_ids.shape[0],
+        capacity=cap,
         index=index,
         user_fields=query_cfg.model.user_field_size,
         rank_fields=f,
+        retrieval=resolve_retrieval_section(
+            index, capacity=cap, top_k=top_k, retrieval=retrieval,
+            oversample=oversample, min_recall=min_recall,
+        ),
     )
     return write_funnel_tree(
         directory, rank_cfg, rank_state, query_cfg, query_state, index, meta
@@ -197,19 +267,31 @@ class FunnelPublisher(ModelPublisher):
         top_k: int = 32,
         return_n: int = 0,
         capacity: int = 0,
+        retrieval: str = "exact",
+        oversample: int = 4,
+        min_recall: float = 0.95,
         cursor: dict | None = None,
         watermark: float = 0.0,
         extra: dict | None = None,
     ) -> Manifest:
-        version = self.next_version()
         f = rank_cfg.model.field_size
+        cap = capacity or index.item_ids.shape[0]
+        # the quality gate runs BEFORE the artifact write: an int8 corpus
+        # whose measured recall misses min_recall raises here and no
+        # version (not even a torn one) exists for it
+        retrieval_section = resolve_retrieval_section(
+            index, capacity=cap, top_k=top_k, retrieval=retrieval,
+            oversample=oversample, min_recall=min_recall,
+        )
+        version = self.next_version()
         meta = funnel_meta(
             item_field=f - 1 if item_field is None else item_field,
             top_k=top_k, return_n=return_n or top_k,
-            capacity=capacity or index.item_ids.shape[0],
+            capacity=cap,
             index=index,
             user_fields=query_cfg.model.user_field_size,
             rank_fields=f,
+            retrieval=retrieval_section,
         )
         manifest = Manifest(
             version=version,
@@ -231,6 +313,7 @@ class FunnelPublisher(ModelPublisher):
                 "query_param_hash": param_tree_hash(
                     _query_payload(query_state), None
                 ),
+                "retrieval": retrieval_section,
             },
         )
         return self._publish_artifact(

@@ -1,0 +1,160 @@
+"""Recall harness for the quantized retrieval tier — quality as a gate.
+
+The int8 scorer is an approximation; what makes it shippable is that the
+approximation error is MEASURED against the bit-exact reference
+(:func:`~deepfm_tpu.funnel.index.brute_force_topk`) and gated before
+anything publishes.  This module is the measuring instrument:
+
+* :func:`simulate_quantized_topk` — a host-side numpy twin of the device
+  int8 path (quantize → approximate-score shortlist of K·oversample with
+  the smaller-row tie-break → exact f32 rescore → lexicographic top-K).
+  Same selection semantics as ``build_retrieve_with``'s int8 branch, no
+  mesh required — so the PUBLISHER can run the gate, not just a serving
+  host.
+* :func:`recall_at_k` — per-query fraction of the reference top-K ids
+  recovered; :func:`measure_recall` runs the whole harness and reports
+  mean and worst-query recall.
+* corpus generators — :func:`seeded_corpus` (the honest random case) and
+  :func:`near_tie_corpus` (the adversarial case: tight clusters whose
+  within-cluster score gaps sit BELOW the int8 rounding error, so the
+  approximate ordering is wrong by construction and only the f32 rescore
+  can recover the true top-K).
+
+``FunnelPublisher.publish_funnel`` runs this harness on every int8
+publish and refuses the version when measured recall falls under the
+manifest's ``min_recall`` — a quality regression is a failed publish,
+not a production surprise.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .quant import dequantize_rows, quantize_rows
+
+
+def seeded_corpus(n: int, d: int, *, seed: int = 0) -> np.ndarray:
+    """Random L2-normalized rows — the distributional case."""
+    rng = np.random.default_rng(seed)
+    emb = rng.normal(size=(n, d)).astype(np.float32)
+    emb /= np.maximum(np.linalg.norm(emb, axis=1, keepdims=True), 1e-12)
+    return emb
+
+
+def near_tie_corpus(n: int, d: int, *, groups: int = 8,
+                    eps: float = 2e-3, seed: int = 0) -> np.ndarray:
+    """The adversarial case: ``groups`` tight clusters of near-duplicate
+    rows, within-cluster perturbations of magnitude ``eps``.
+
+    A per-row symmetric int8 code has worst-case element error
+    ``max|row| / 254`` (~4e-3 for unit rows); with ``eps`` at or below
+    that, int8 rounding reorders rows WITHIN a cluster essentially at
+    will.  An oversample wide enough to keep the whole cluster in the
+    shortlist lets the exact rescore restore the true order — which is
+    precisely the property the rescue-the-near-ties test pins."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(groups, d)).astype(np.float32)
+    centers /= np.maximum(np.linalg.norm(centers, axis=1, keepdims=True),
+                          1e-12)
+    emb = centers[np.arange(n) % groups]
+    emb = emb + eps * rng.normal(size=(n, d)).astype(np.float32)
+    return (emb / np.maximum(np.linalg.norm(emb, axis=1, keepdims=True),
+                             1e-12)).astype(np.float32)
+
+
+def probe_queries(emb: np.ndarray, n_queries: int, *,
+                  seed: int = 0) -> np.ndarray:
+    """The harness's query mix: half random unit vectors (the generic
+    case), half corpus rows themselves (every item queried by its own
+    embedding sits in maximal near-tie territory with its neighbors)."""
+    rng = np.random.default_rng(seed)
+    n, d = emb.shape
+    n_rand = max(1, n_queries // 2)
+    q = rng.normal(size=(n_rand, d)).astype(np.float32)
+    q /= np.maximum(np.linalg.norm(q, axis=1, keepdims=True), 1e-12)
+    n_self = min(n, n_queries - n_rand)
+    if n_self > 0:
+        rows = rng.choice(n, size=n_self, replace=False)
+        q = np.concatenate([q, emb[rows]], axis=0)
+    return q
+
+
+def simulate_quantized_topk(
+    emb: np.ndarray,
+    item_ids: np.ndarray,
+    queries: np.ndarray,
+    k: int,
+    *,
+    oversample: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side twin of the device int8 path: approximate shortlist of
+    ``k * oversample`` by dequantized scores (ties toward the smaller
+    row — ``lax.top_k``'s earlier-index rule), exact f32 rescore of the
+    shortlist, lexicographic (-score, row) top-``k``.  Returns
+    ``(scores [B, k] f32, ids [B, k] i32)``."""
+    emb = np.asarray(emb, np.float32)
+    item_ids = np.asarray(item_ids, np.int32)
+    queries = np.asarray(queries, np.float32)
+    codes, scales = quantize_rows(emb)
+    deq = dequantize_rows(codes, scales)
+    kos = min(k * int(oversample), emb.shape[0])
+    rows = np.arange(emb.shape[0])
+    out_s = np.full((queries.shape[0], k), -np.inf, np.float32)
+    out_i = np.full((queries.shape[0], k), -1, np.int32)
+    for b in range(queries.shape[0]):
+        approx = queries[b] @ deq.T
+        approx[item_ids < 0] = -np.inf
+        short = np.lexsort((rows, -approx))[:kos]
+        exact = queries[b] @ emb[short].T
+        exact[item_ids[short] < 0] = -np.inf
+        order = np.lexsort((short, -exact))[:k]
+        take = short[order]
+        out_s[b, :take.size] = exact[order]
+        out_i[b, :take.size] = item_ids[take]
+    return out_s, out_i
+
+
+def recall_at_k(got_ids: np.ndarray, ref_ids: np.ndarray) -> np.ndarray:
+    """Per-query fraction of the reference's REAL top-K ids (pads in the
+    reference don't count against either side)."""
+    got_ids = np.asarray(got_ids)
+    ref_ids = np.asarray(ref_ids)
+    out = np.empty(ref_ids.shape[0], np.float64)
+    for b in range(ref_ids.shape[0]):
+        ref = ref_ids[b][ref_ids[b] >= 0]
+        if ref.size == 0:
+            out[b] = 1.0
+            continue
+        out[b] = np.isin(ref, got_ids[b]).mean()
+    return out
+
+
+def measure_recall(
+    emb: np.ndarray,
+    item_ids: np.ndarray,
+    k: int,
+    *,
+    oversample: int,
+    n_queries: int = 256,
+    seed: int = 0,
+) -> dict:
+    """Run the harness end-to-end: probe queries, quantized path vs
+    ``brute_force_topk``, recall@k summary.  The publish gate compares
+    ``recall`` (the mean) against ``min_recall`` and records the worst
+    query alongside — a gate that passes on average but hides a zero
+    would still be visible in the manifest."""
+    from .index import brute_force_topk
+
+    queries = probe_queries(np.asarray(emb, np.float32), int(n_queries),
+                            seed=seed)
+    _, ref_ids = brute_force_topk(emb, item_ids, queries, k)
+    _, got_ids = simulate_quantized_topk(emb, item_ids, queries, k,
+                                         oversample=oversample)
+    per_q = recall_at_k(got_ids, ref_ids)
+    return {
+        "recall": float(per_q.mean()),
+        "worst_query_recall": float(per_q.min()),
+        "k": int(k),
+        "oversample": int(oversample),
+        "n_queries": int(queries.shape[0]),
+    }

@@ -55,6 +55,7 @@ from .index import (
     FunnelContext,
     build_rank_topn_with,
     build_retrieve_with,
+    funnel_score_bytes_est,
     funnel_wire_bytes_est,
     index_hash,
     make_funnel_context,
@@ -98,7 +99,16 @@ class FunnelScorer:
     ``user_fields + rank_fields``; the engine's buckets are the funnel's
     precompiled shapes), with the combined payload behind a drain-aware
     swap.  ``top_k``/``return_n`` of 0 take the servable's funnel.json
-    defaults."""
+    defaults; ``retrieval``/``oversample`` of ""/0 take the servable's
+    published ``retrieval`` section (exact when none was stamped).
+
+    With an :class:`~deepfm_tpu.serve.control.admission.AdmissionController`
+    attached and an int8 index, the scorer also compiles a DEGRADED
+    retrieve executable whose oversample is shrunk by the ladder's
+    level-2 ``degrade_factor()`` — under sustained saturation the
+    shortlist narrows (recall degrades inside the published budget)
+    instead of requests dying at the door; transitions are
+    flight-recorded."""
 
     def __init__(
         self,
@@ -107,9 +117,13 @@ class FunnelScorer:
         *,
         top_k: int = 0,
         return_n: int = 0,
+        retrieval: str = "",
+        oversample: int = 0,
+        pallas: str = "",
         buckets=DEFAULT_BUCKETS,
         max_wait_ms: float = 2.0,
         max_queue_rows: int | None = None,
+        admission=None,
         precompile: bool = True,
         name: str = "recommend",
         registry: MetricsRegistry | None = None,
@@ -126,12 +140,16 @@ class FunnelScorer:
                 f"mesh's data_parallel={dp} — every dispatch shape must "
                 f"shard evenly"
             )
+        rsec = meta.get("retrieval") or {}
         self.ctx = make_funnel_context(
             art.rank_cfg, art.query_cfg, mesh,
             capacity=int(meta.get("capacity") or art.index.item_ids.shape[0]),
             top_k=int(top_k) or int(meta["top_k"]),
             return_n=int(return_n) or int(meta["return_n"]),
             item_field=int(meta["item_field"]),
+            retrieval=retrieval or str(rsec.get("mode", "exact")),
+            oversample=int(oversample) or int(rsec.get("oversample", 4)),
+            pallas=pallas or "auto",
         )
         payload = stage_funnel_payload(
             self.ctx, art.rank_params, art.rank_state, art.query_params,
@@ -140,6 +158,23 @@ class FunnelScorer:
         self.holder = FunnelHolder(payload, version=0)
         self._retrieve_with = build_retrieve_with(self.ctx)
         self._rank_with = build_rank_topn_with(self.ctx)
+        # the shed ladder's level-2 degrade also narrows the int8
+        # shortlist: a SECOND retrieve executable at the floored
+        # oversample, compiled at boot, picked per dispatch off
+        # admission.degrade_factor() — never a recompile under load
+        self._admission = admission
+        self._retrieve_degraded = None
+        self._degraded_os = self.ctx.oversample
+        self._degraded_active = False
+        self.degraded_dispatch_total = 0
+        if (admission is not None and self.ctx.retrieval_mode == "int8"
+                and self.ctx.oversample > 1):
+            os_d = max(1, int(self.ctx.oversample * admission.degrade_floor))
+            if os_d < self.ctx.oversample:
+                self._degraded_os = os_d
+                self._retrieve_degraded = build_retrieve_with(
+                    self.ctx._replace(oversample=os_d)
+                )
         self._boot_items = int(art.index.item_ids.shape[0])
         self._canary = _canary_probes(self.ctx, int(sorted(buckets)[0]))
         self._flock = threading.Lock()
@@ -164,7 +199,7 @@ class FunnelScorer:
             self.ctx.user_fields + self.ctx.rank_fields,
             buckets=buckets, max_wait_ms=max_wait_ms,
             max_queue_rows=max_queue_rows, name=name,
-            registry=self.registry,
+            registry=self.registry, admission=admission,
         )
         # consumers that wrap the ENGINE in the generic handler (the pool
         # member) still get the funnel metrics section — same hasattr
@@ -181,10 +216,27 @@ class FunnelScorer:
         import jax
 
         fu = self.ctx.user_fields
+        retrieve = self._retrieve_with
+        degraded = False
+        if (self._retrieve_degraded is not None
+                and self._admission.degrade_factor() < 1.0):
+            retrieve = self._retrieve_degraded
+            degraded = True
+        if degraded != self._degraded_active and not self._precompiling:
+            # one record per transition (the engine fn runs on the single
+            # batcher worker thread, but funnel_snapshot reads the flag
+            # from scrape threads — publish the flip under the lock)
+            with self._flock:
+                self._degraded_active = degraded
+            obs_flight.record(
+                "funnel_degrade", subsystem="funnel", engaged=degraded,
+                oversample=self._degraded_os if degraded
+                else self.ctx.oversample,
+            )
         payload, gen = self.holder.acquire()
         try:
             t0 = time.perf_counter()
-            scores, cand = self._retrieve_with(
+            scores, cand = retrieve(
                 payload, ids[:, :fu], vals[:, :fu]
             )
             jax.block_until_ready((scores, cand))
@@ -206,6 +258,8 @@ class FunnelScorer:
         with self._flock:
             self.candidates_total += ids.shape[0] * self.ctx.top_k
             self.retrieval_secs_total += t1 - t0
+            if degraded:
+                self.degraded_dispatch_total += 1
             if overflow:
                 # the merge returned pad entries: the corpus holds fewer
                 # valid items than top_k asks for
@@ -312,6 +366,17 @@ class FunnelScorer:
         except Exception:
             self._purge_staged(local, staging_dir)
             raise
+        pub_mode = (manifest.index.get("retrieval") or {}).get("mode")
+        if pub_mode is not None and pub_mode != self.ctx.retrieval_mode:
+            # a policy refusal, not corruption: the publish-time recall
+            # gate ran for pub_mode, so serving it under another mode
+            # would void the quality budget the manifest records
+            raise ValueError(
+                f"version {version} was published for retrieval mode "
+                f"{pub_mode!r} but this scorer serves "
+                f"{self.ctx.retrieval_mode!r} — retrieval-mode skew; "
+                f"republish for this mode or redeploy the scorer"
+            )
         payload = stage_funnel_payload(
             self.ctx, art.rank_params, art.rank_state, art.query_params,
             art.index,
@@ -394,6 +459,16 @@ class FunnelScorer:
                 "index_capacity": self.ctx.capacity,
                 "top_k": self.ctx.top_k,
                 "return_n": self.ctx.return_n,
+                "retrieval_mode": self.ctx.retrieval_mode,
+                "oversample": self.ctx.oversample,
+                "oversample_effective": (
+                    self._degraded_os if self._degraded_active
+                    else self.ctx.oversample
+                ),
+                "kernel_engaged": bool(getattr(
+                    self._retrieve_with, "kernel_engaged", False
+                )),
+                "degraded_dispatch_total": self.degraded_dispatch_total,
                 "candidates_total": self.candidates_total,
                 "candidates_per_sec": (
                     round(self.candidates_total / secs, 1) if secs else None
@@ -405,12 +480,29 @@ class FunnelScorer:
         out["wire_bytes_est"] = funnel_wire_bytes_est(
             self.ctx, max(self.engine.buckets)
         )
+        out.update(funnel_score_bytes_est(
+            self.ctx, max(self.engine.buckets)
+        ))
         return out
 
     def precompile(self) -> dict:
         self._precompiling = True
         try:
             self.compile_secs = self.engine.precompile()
+            if self._retrieve_degraded is not None:
+                # the degraded executable must be warm BEFORE the ladder
+                # engages — compiling it mid-saturation would add compile
+                # time exactly when the engine is drowning
+                import jax
+                payload, gen = self.holder.acquire()
+                try:
+                    for b in sorted(self.engine.buckets):
+                        uids, uvals, _, _ = _canary_probes(self.ctx, int(b))
+                        jax.block_until_ready(
+                            self._retrieve_degraded(payload, uids, uvals)
+                        )
+                finally:
+                    self.holder.release(gen)
         finally:
             self._precompiling = False
         return self.compile_secs
@@ -639,6 +731,9 @@ def serve_funnel(
     reload_interval_secs: float = 2.0,
     top_k: int = 0,
     return_n: int = 0,
+    retrieval: str = "",
+    oversample: int = 0,
+    pallas: str = "",
     data_parallel: int = 1,
     model_parallel: int = 0,
     trace_sample_rate: float | None = None,
@@ -661,6 +756,7 @@ def serve_funnel(
     mesh = build_serve_mesh(data_parallel, model_parallel)
     scorer = FunnelScorer(
         servable_dir, mesh, top_k=top_k, return_n=return_n,
+        retrieval=retrieval, oversample=oversample, pallas=pallas,
         buckets=buckets, max_wait_ms=max_wait_ms,
         max_queue_rows=max_queue_rows,
     )
@@ -675,7 +771,8 @@ def serve_funnel(
 
     def readiness():
         doc = {"ready": True, "engine_compiled": True,
-               "weights_loaded": True}
+               "weights_loaded": True,
+               "retrieval_mode": scorer.ctx.retrieval_mode}
         mv, iv = scorer.versions()
         doc["model_version"], doc["index_version"] = mv, iv
         if swapper is not None:
@@ -706,6 +803,7 @@ def serve_funnel(
         f"serving funnel {model_name} on http://{httpd.server_address[0]}:"
         f"{httpd.server_address[1]}{RECOMMEND_PATH} "
         f"(mesh [{data_parallel},{model_parallel}], "
+        f"retrieval {scorer.ctx.retrieval_mode}, "
         f"top_k {scorer.ctx.top_k} -> return_n {scorer.ctx.return_n})",
         file=sys.stderr,
     )

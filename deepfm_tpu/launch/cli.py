@@ -121,6 +121,29 @@ def build_parser() -> argparse.ArgumentParser:
              "(0 = the servable's funnel.json default)",
     )
     p.add_argument(
+        "--funnel_retrieval", choices=("exact", "int8", "auto"),
+        help="funnel retrieval tier (funnel/quant.py): exact f32 "
+             "scoring, int8 quantized scoring with exact f32 rescore of "
+             "the oversampled shortlist, or auto (int8 at large index "
+             "capacity)",
+    )
+    p.add_argument(
+        "--funnel_oversample", type=int,
+        help="int8 shortlist width multiplier: K*oversample candidates "
+             "survive the quantized pass into the exact rescore",
+    )
+    p.add_argument(
+        "--funnel_min_recall", type=float,
+        help="publish-time recall gate for int8 funnel versions "
+             "(funnel/recall.py; in (0, 1])",
+    )
+    p.add_argument(
+        "--funnel_pallas", choices=("on", "off", "auto"),
+        help="the fused Pallas score/top-k retrieval kernel "
+             "(ops/pallas_retrieval.py): on | off | auto (TPU backends, "
+             "compile-probe fallback to the lax composition)",
+    )
+    p.add_argument(
         "--coordinator_url",
         help="multi-host elastic coordination service "
              "(deepfm_tpu/elastic/coord.py; run one with `python -m "
@@ -180,6 +203,10 @@ _FLAG_MAP = {
     "serve_group_mp": ("run", "serve_group_model_parallel"),
     "funnel_top_k": ("run", "funnel_top_k"),
     "funnel_return_n": ("run", "funnel_return_n"),
+    "funnel_retrieval": ("run", "funnel_retrieval"),
+    "funnel_oversample": ("run", "funnel_oversample"),
+    "funnel_min_recall": ("run", "funnel_min_recall"),
+    "funnel_pallas": ("run", "funnel_pallas"),
     "serve_tenants": ("fleet", "tenants"),
     "coordinator_url": ("elastic", "coordinator_url"),
     "lease_ttl_secs": ("elastic", "lease_ttl_secs"),

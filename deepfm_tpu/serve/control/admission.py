@@ -17,7 +17,11 @@ declared cheapest-first order as smoothed queue utilization climbs:
     level 1  shadow-scoring offers     (zero user impact — a challenger
                                         loses samples, counted)
     level 2  recommend expand/rank     (degraded answers, never absent
-             width -> configured floor  ones)
+             width -> configured floor  ones; an int8 funnel also
+                                        narrows its retrieval oversample
+                                        to the floor — funnel/serve.py
+                                        keeps a pre-compiled degraded
+                                        executable for it)
     level 3  plain predicts            (503 + Retry-After at admission)
 
 Utilization is EWMA-smoothed so one burst cannot flip levels, and each
@@ -177,10 +181,19 @@ class AdmissionController:
         with self._lock:
             return self._level
 
+    @property
+    def degrade_floor(self) -> float:
+        """The configured level-2 width multiplier — what
+        :meth:`degrade_factor` returns once the ladder engages.  Callers
+        that pre-compile a degraded executable (the funnel's narrowed
+        oversample) size it off this at boot."""
+        return self._degrade_floor
+
     def degrade_factor(self) -> float:
-        """Width multiplier for recommend expand/rank at the current
-        ladder level: 1.0 normally, the configured floor at level >= 2
-        (degraded answers beat absent ones)."""
+        """Width multiplier for recommend expand/rank (and the int8
+        funnel's retrieval oversample) at the current ladder level: 1.0
+        normally, the configured floor at level >= 2 (degraded answers
+        beat absent ones)."""
         return self._degrade_floor if self.level() >= 2 else 1.0
 
     # -- the admission decision --------------------------------------------

@@ -175,6 +175,9 @@ class GroupMember:
         staging_dir: str | None = None,
         funnel_top_k: int = 0,
         funnel_return_n: int = 0,
+        funnel_retrieval: str = "",
+        funnel_oversample: int = 0,
+        funnel_pallas: str = "",
         precompile: bool = True,
         registry: MetricsRegistry | None = None,
         tenants=None,
@@ -205,10 +208,33 @@ class GroupMember:
             # same group-atomic swap protocol as CTR weights
             from ...funnel.serve import FunnelScorer
 
+            # a funnel member with an SLO gets its admission controller
+            # built FIRST: the scorer wires it into its engine (deadline
+            # pricing + the shed ladder on /v1/recommend) and — for int8
+            # retrieval — compiles the degraded-oversample executable
+            # the ladder's level-2 narrows to
+            self.admission = None
+            if slo is not None:
+                from ..control.admission import AdmissionController
+                from ..control.cost import BucketCostModel
+
+                self.admission = AdmissionController(
+                    BucketCostModel(buckets),
+                    deadline_ms=slo.deadline_ms,
+                    shed_shadow_util=slo.shed_shadow_util,
+                    degrade_util=slo.degrade_util,
+                    shed_predict_util=slo.shed_predict_util,
+                    degrade_floor_pct=slo.degrade_floor_pct,
+                    name=f"recommend[{group}/{member}]",
+                    registry=self.registry,
+                )
             self._scorer = FunnelScorer(
                 servable_dir, mesh, top_k=funnel_top_k,
-                return_n=funnel_return_n, buckets=buckets,
+                return_n=funnel_return_n, retrieval=funnel_retrieval,
+                oversample=funnel_oversample, pallas=funnel_pallas,
+                buckets=buckets,
                 max_wait_ms=max_wait_ms, max_queue_rows=max_queue_rows,
+                admission=self.admission,
                 precompile=False, name=f"recommend[{group}/{member}]",
                 registry=self.registry,
             )
@@ -278,23 +304,24 @@ class GroupMember:
         # a core.config.SloConfig): the tenants share the same bucket
         # executables and the same devices, so one cost model prices all
         # of them and one shed ladder answers for the member's queue
-        # pressure.  Funnel members keep their own engine construction —
-        # the SLO control plane covers the CTR predict path.
-        self.admission = None
-        if slo is not None and not self.funnel:
-            from ..control.admission import AdmissionController
-            from ..control.cost import BucketCostModel
+        # pressure.  Funnel members built theirs above, before the
+        # scorer, so it rides inside the FunnelScorer's engine.
+        if not self.funnel:
+            self.admission = None
+            if slo is not None:
+                from ..control.admission import AdmissionController
+                from ..control.cost import BucketCostModel
 
-            self.admission = AdmissionController(
-                BucketCostModel(buckets),
-                deadline_ms=slo.deadline_ms,
-                shed_shadow_util=slo.shed_shadow_util,
-                degrade_util=slo.degrade_util,
-                shed_predict_util=slo.shed_predict_util,
-                degrade_floor_pct=slo.degrade_floor_pct,
-                name=f"predict[{group}/{member}]",
-                registry=self.registry,
-            )
+                self.admission = AdmissionController(
+                    BucketCostModel(buckets),
+                    deadline_ms=slo.deadline_ms,
+                    shed_shadow_util=slo.shed_shadow_util,
+                    degrade_util=slo.degrade_util,
+                    shed_predict_util=slo.shed_predict_util,
+                    degrade_floor_pct=slo.degrade_floor_pct,
+                    name=f"predict[{group}/{member}]",
+                    registry=self.registry,
+                )
         if self.funnel:
             ts = _TenantState(specs[0].name, specs[0].source or source)
             ts.holder = holder
@@ -494,11 +521,14 @@ class GroupMember:
                    "model_version": ts.holder.version}
             for name, ts in self._tenants.items()
         }
-        return {
+        doc = {
             "ready": True, "engine_compiled": True, "weights_loaded": True,
             "model_version": self._holder.version,
             "tenants": tenants,
         }
+        if self.funnel:
+            doc["retrieval_mode"] = self._scorer.ctx.retrieval_mode
+        return doc
 
     # -- swap protocol (member half; swap.py is the coordinator) ------------
     def stage(self, version: int, source: str | None = None,

@@ -704,6 +704,8 @@ def serve_pool(
     max_queue_rows: int | None = None, item_corpus: str | None = None,
     reload_url: str | None = None, reload_interval_secs: float = 2.0,
     funnel_top_k: int = 0, funnel_return_n: int = 0,
+    funnel_retrieval: str = "", funnel_oversample: int = 0,
+    funnel_pallas: str = "",
     funnel_data_parallel: int = 1, funnel_model_parallel: int = 0,
     max_restarts: int = 10,
     ready: threading.Event | None = None,
@@ -760,6 +762,9 @@ def serve_pool(
                     reload_interval_secs=reload_interval_secs,
                     funnel_top_k=funnel_top_k,
                     funnel_return_n=funnel_return_n,
+                    funnel_retrieval=funnel_retrieval,
+                    funnel_oversample=funnel_oversample,
+                    funnel_pallas=funnel_pallas,
                     funnel_data_parallel=funnel_data_parallel,
                     funnel_model_parallel=funnel_model_parallel,
                 )
@@ -847,6 +852,8 @@ def serve_forever(
     item_corpus: str | None = None,
     reload_url: str | None = None, reload_interval_secs: float = 2.0,
     funnel_top_k: int = 0, funnel_return_n: int = 0,
+    funnel_retrieval: str = "", funnel_oversample: int = 0,
+    funnel_pallas: str = "",
     funnel_data_parallel: int = 1, funnel_model_parallel: int = 0,
     trace_sample_rate: float = DEFAULT_SAMPLE_RATE,
     trace_export: str | None = None,
@@ -890,6 +897,8 @@ def serve_forever(
             reload_url=reload_url,
             reload_interval_secs=reload_interval_secs,
             top_k=funnel_top_k, return_n=funnel_return_n,
+            retrieval=funnel_retrieval, oversample=funnel_oversample,
+            pallas=funnel_pallas,
             data_parallel=funnel_data_parallel,
             model_parallel=funnel_model_parallel,
             trace_sample_rate=trace_sample_rate,
@@ -1120,6 +1129,26 @@ def main(argv: list[str] | None = None) -> int:
              "(0 = the servable's funnel.json default)",
     )
     ap.add_argument(
+        "--funnel-retrieval", default="",
+        choices=("", "exact", "int8", "auto"),
+        help="funnel retrieval tier: exact f32 scoring, int8 quantized "
+             "scoring with exact f32 rescore of the oversampled "
+             "shortlist, or auto (int8 once the index capacity crosses "
+             "funnel/quant.AUTO_INT8_MIN_ROWS); '' = the servable's "
+             "published retrieval section",
+    )
+    ap.add_argument(
+        "--funnel-oversample", type=int, default=0,
+        help="int8 shortlist width multiplier (K*oversample candidates "
+             "survive the quantized pass into the exact rescore; "
+             "0 = the servable's published value)",
+    )
+    ap.add_argument(
+        "--funnel-pallas", default="", choices=("", "on", "off", "auto"),
+        help="the fused Pallas score/top-k retrieval kernel: on | off | "
+             "auto (TPU backends, compile-probe fallback); '' = auto",
+    )
+    ap.add_argument(
         "--funnel-dp", type=int, default=1,
         help="funnel mesh: request-batch shard factor (buckets must "
              "divide by it)",
@@ -1168,6 +1197,9 @@ def main(argv: list[str] | None = None) -> int:
             reload_interval_secs=args.reload_interval,
             funnel_top_k=args.funnel_top_k,
             funnel_return_n=args.funnel_return_n,
+            funnel_retrieval=args.funnel_retrieval,
+            funnel_oversample=args.funnel_oversample,
+            funnel_pallas=args.funnel_pallas,
             funnel_data_parallel=args.funnel_dp,
             funnel_model_parallel=args.funnel_mp,
         )
@@ -1181,6 +1213,9 @@ def main(argv: list[str] | None = None) -> int:
         reload_interval_secs=args.reload_interval,
         funnel_top_k=args.funnel_top_k,
         funnel_return_n=args.funnel_return_n,
+        funnel_retrieval=args.funnel_retrieval,
+        funnel_oversample=args.funnel_oversample,
+        funnel_pallas=args.funnel_pallas,
         funnel_data_parallel=args.funnel_dp,
         funnel_model_parallel=args.funnel_mp,
         trace_sample_rate=args.trace_sample,

@@ -836,6 +836,13 @@ def run_task(cfg: Config):
                 argv += ["--funnel-top-k", str(cfg.run.funnel_top_k)]
             if cfg.run.funnel_return_n:
                 argv += ["--funnel-return-n", str(cfg.run.funnel_return_n)]
+            if cfg.run.funnel_retrieval != "exact":
+                argv += ["--funnel-retrieval", cfg.run.funnel_retrieval]
+            if cfg.run.funnel_oversample != 4:
+                argv += ["--funnel-oversample",
+                         str(cfg.run.funnel_oversample)]
+            if cfg.run.funnel_pallas != "auto":
+                argv += ["--funnel-pallas", cfg.run.funnel_pallas]
             if cfg.flywheel.enabled:
                 # data flywheel (deepfm_tpu/flywheel): the router logs
                 # a hash-stable sample of scored impressions for the
@@ -866,6 +873,12 @@ def run_task(cfg: Config):
                 reload_interval_secs=cfg.run.serve_reload_interval_secs,
                 funnel_top_k=cfg.run.funnel_top_k,
                 funnel_return_n=cfg.run.funnel_return_n,
+                funnel_retrieval=("" if cfg.run.funnel_retrieval == "exact"
+                                  else cfg.run.funnel_retrieval),
+                funnel_oversample=(0 if cfg.run.funnel_oversample == 4
+                                   else cfg.run.funnel_oversample),
+                funnel_pallas=("" if cfg.run.funnel_pallas == "auto"
+                               else cfg.run.funnel_pallas),
             )
             return None
         serve_forever(
@@ -879,6 +892,15 @@ def run_task(cfg: Config):
             reload_interval_secs=cfg.run.serve_reload_interval_secs,
             funnel_top_k=cfg.run.funnel_top_k,
             funnel_return_n=cfg.run.funnel_return_n,
+            # config defaults defer to the servable's published retrieval
+            # section (the funnel_top_k=0 convention); a non-default
+            # value is an explicit operator override
+            funnel_retrieval=("" if cfg.run.funnel_retrieval == "exact"
+                              else cfg.run.funnel_retrieval),
+            funnel_oversample=(0 if cfg.run.funnel_oversample == 4
+                               else cfg.run.funnel_oversample),
+            funnel_pallas=("" if cfg.run.funnel_pallas == "auto"
+                           else cfg.run.funnel_pallas),
         )
         return None
     if cfg.model.model_name == "two_tower":

@@ -76,7 +76,13 @@ fi
 # expand+rank executables must lower transfer-guard-clean with the index
 # as lowered parameters (a refresh is a cache hit), per-shard top-k
 # present, and no collective moving a corpus-sized operand (only the
-# [B_local, K] candidate packs cross the wire) — and the ELASTIC contract
+# [B_local, K] candidate packs cross the wire); the audit lowers BOTH
+# retrieval modes on both meshes, and the int8 tier carries two extra
+# bandwidth checks on the lowered text — no corpus-sized f32 RESULT
+# (the quantized scorer streams int8 tiles; a whole-shard
+# codes.astype(f32) is the copy the tier exists to never make) and no
+# corpus-sized gather result (the exact rescore may gather only the
+# K*oversample shortlist) — and the ELASTIC contract
 # (audit_elastic): the N→M reshard's row-adapt executables must lower
 # under transfer_guard('disallow') with the table as a lowered parameter
 # (no host round-trip on table leaves) and the redistribution plan must
@@ -115,7 +121,8 @@ fi
 # Seeded violations in tests/test_analysis.py (smuggled transfer,
 # dense-row leak, off-bucket/indivisible shape, baked mixed-generation
 # payload, spec-divergent tenants claiming one executable, baked tenant
-# payload, full-corpus score gather, baked index, reshard host round-trip,
+# payload, full-corpus score gather, baked index, whole-shard int8
+# dequantize, corpus-sized rescore gather, reshard host round-trip,
 # baked reshard table, host timer closed over a traced value, registry
 # call inside a jitted fn, admission check on a traced queue depth,
 # io_callback scale decision inside jit, staleness note on a traced

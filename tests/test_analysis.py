@@ -2080,6 +2080,111 @@ class TestFunnelContract:
         assert any(f.rule == "trace-recompile"
                    and "baked" in f.message for f in findings), findings
 
+    def test_seeded_whole_shard_dequantize_caught(self):
+        """The int8 tier's bandwidth contract, violated the obvious way:
+        dequantize the WHOLE shard's code matrix to f32 before scoring.
+        The lowering then materializes a corpus-sized f32 result — the
+        exact copy the quantized scorer exists to never hold."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from deepfm_tpu.analysis.trace_audit import audit_funnel
+        from deepfm_tpu.core.compat import shard_map
+        from deepfm_tpu.models.two_tower import encode_tower
+        from deepfm_tpu.parallel.mesh import DATA_AXIS
+
+        def dequant_builder(ctx):
+            qcfg = ctx.query_cfg.model
+            k = ctx.top_k
+
+            def local(payload, uids, uvals):
+                u = encode_tower(payload["query"], uids, uvals,
+                                 cfg=qcfg, side="user")
+                codes = payload["index"]["item_codes"]
+                scl = payload["index"]["item_scales"]
+                iid = payload["index"]["item_ids"]
+                # the violation: a [rows_local, D] f32 copy of the shard
+                deq = codes.astype(jnp.float32) * scl[:, None]
+                s = u @ deq.T
+                s = jnp.where(iid[None, :] >= 0, s, -jnp.inf)
+                sk, li = lax.top_k(s, k)
+                return sk, jnp.take(iid, li)
+
+            mapped = shard_map(
+                local, mesh=ctx.mesh,
+                in_specs=(ctx.payload_specs, P(DATA_AXIS, None),
+                          P(DATA_AXIS, None)),
+                out_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
+                check_vma=False,
+            )
+            return jax.jit(lambda p, i, v: mapped(p, i, v))
+
+        findings = audit_funnel(retrieve_builder=dequant_builder,
+                                modes=("int8",))
+        assert any(f.rule == "trace-quantized"
+                   and f.source.endswith("corpus-f32")
+                   for f in findings), findings
+
+    def test_seeded_corpus_rescore_gather_caught(self):
+        """The other int8 leak: scoring streams tiles correctly, but the
+        rescore stage gathers a corpus-sized result instead of only the
+        K*oversample shortlist.  The dtype-agnostic gather matcher must
+        convict it even though no corpus-sized f32 exists."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from deepfm_tpu.analysis.trace_audit import audit_funnel
+        from deepfm_tpu.core.compat import shard_map
+        from deepfm_tpu.models.two_tower import encode_tower
+        from deepfm_tpu.ops.pallas_retrieval import score_topk_tiles
+        from deepfm_tpu.parallel.mesh import DATA_AXIS
+
+        def gathering_builder(ctx):
+            qcfg = ctx.query_cfg.model
+            k = ctx.top_k
+            kos = ctx.top_k * ctx.oversample
+            tile = ctx.retrieval_tile
+
+            def local(payload, uids, uvals):
+                u = encode_tower(payload["query"], uids, uvals,
+                                 cfg=qcfg, side="user")
+                codes = payload["index"]["item_codes"]
+                scl = payload["index"]["item_scales"]
+                iid = payload["index"]["item_ids"]
+                s_a, rows = score_topk_tiles(u, codes, scl, iid,
+                                             kos=kos, tile=tile)
+                # the violation: a corpus-sized (i32) gather — and kept
+                # live by routing the shortlist ids through it
+                order = jnp.argsort(iid)
+                iid_sorted = jnp.take(iid, order)
+                inv = jnp.argsort(order)
+                cid = jnp.take(iid_sorted, jnp.take(inv, rows))
+                sk, ci = lax.top_k(s_a, k)
+                return sk, jnp.take_along_axis(cid, ci, axis=1)
+
+            mapped = shard_map(
+                local, mesh=ctx.mesh,
+                in_specs=(ctx.payload_specs, P(DATA_AXIS, None),
+                          P(DATA_AXIS, None)),
+                out_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
+                check_vma=False,
+            )
+            return jax.jit(lambda p, i, v: mapped(p, i, v))
+
+        findings = audit_funnel(retrieve_builder=gathering_builder,
+                                modes=("int8",))
+        assert any(f.rule == "trace-quantized"
+                   and f.source.endswith("rescore-gather")
+                   for f in findings), findings
+        # the scoring stage really did stream tiles: the f32 rule must
+        # NOT fire, or this test would prove nothing about the gather
+        assert not any(f.source.endswith("corpus-f32")
+                       for f in findings), findings
+
 
 class TestElasticReshardContract:
     """The elastic reshard's trace contract (trace_audit.audit_elastic,

@@ -137,6 +137,11 @@ def test_serve_task_dispatch(monkeypatch):
         "reload_interval_secs": 2.0,
         "funnel_top_k": 0,   # 0 = the servable's funnel.json defaults
         "funnel_return_n": 0,
+        # ""/0 = the servable's published retrieval section; config
+        # defaults are not operator overrides
+        "funnel_retrieval": "",
+        "funnel_oversample": 0,
+        "funnel_pallas": "",
     }
 
 
